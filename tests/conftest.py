@@ -1,0 +1,43 @@
+"""Test harness: force an 8-device virtual CPU mesh.
+
+All distributed kernels run under the Pallas TPU interpreter on CPU devices
+(remote DMA + semaphores are simulated faithfully), so the full 8-way
+distributed test suite runs on a CPU-only box — the simulation story the
+reference lacks (SURVEY.md §4).
+"""
+
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = _flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# The environment may pre-register an accelerator platform plugin; force CPU
+# regardless (backends initialize lazily, so this takes effect).
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def mesh8():
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    return make_mesh({"tp": 8})
+
+
+@pytest.fixture(scope="session")
+def mesh4x2():
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+
+    return make_mesh({"ep": 4, "tp": 2}, set_default=False)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

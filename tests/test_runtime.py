@@ -1,0 +1,72 @@
+"""Runtime core tests: mesh, topology, workspaces, utils."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from triton_distributed_tpu.runtime import (
+    Topology,
+    assert_allclose,
+    get_workspace,
+    make_mesh,
+    perf_func,
+)
+from triton_distributed_tpu.runtime.mesh import ring_neighbors
+from triton_distributed_tpu.runtime.symm import clear_workspaces, signal_buffer
+
+
+def test_make_mesh_default(mesh8):
+    assert mesh8.shape == {"tp": 8}
+
+
+def test_make_mesh_factored():
+    m = make_mesh({"ep": 2, "tp": -1}, set_default=False)
+    assert m.shape == {"ep": 2, "tp": 4}
+
+
+def test_make_mesh_bad_shape():
+    with pytest.raises(ValueError):
+        make_mesh({"tp": 3}, set_default=False)
+
+
+def test_topology():
+    t = Topology.detect()
+    assert t.num_devices == 8
+    assert t.devices_per_slice * t.num_slices == t.num_devices
+
+
+def test_ring_neighbors():
+    assert ring_neighbors(0, 8) == (7, 1)
+    assert ring_neighbors(7, 8) == (6, 0)
+
+
+def test_workspace_persistence(mesh8):
+    clear_workspaces()
+    w1 = get_workspace("ag", (16, 128), jnp.float32, mesh=mesh8)
+    w2 = get_workspace("ag", (16, 128), jnp.float32, mesh=mesh8)
+    assert w1 is w2
+    assert w1.array.shape == (8, 16, 128)
+    w3 = get_workspace("ag", (32, 128), jnp.float32, mesh=mesh8)
+    assert w3 is not w1
+
+
+def test_signal_buffer(mesh8):
+    s = signal_buffer("barrier", 4, mesh=mesh8)
+    assert s.array.shape == (8, 4)
+    assert s.array.dtype == jnp.int32
+
+
+def test_perf_func():
+    f = jax.jit(lambda: jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+    out, ms = perf_func(f, warmup=1, iters=3)
+    assert out.shape == (128, 128)
+    assert ms > 0
+
+
+def test_assert_allclose_reports():
+    a = np.zeros((4, 4), np.float32)
+    b = np.zeros((4, 4), np.float32)
+    b[1, 2] = 1.0
+    with pytest.raises(AssertionError, match="worst at"):
+        assert_allclose(a, b)

@@ -1,0 +1,28 @@
+"""Runtime core (L4): mesh bring-up, symmetric workspaces, utilities.
+
+TPU-native analog of the reference host runtime
+(``python/triton_dist/utils.py`` — initialize_distributed, nvshmem_create_tensor,
+BarrierAllContext, perf_func, dist_print, group_profile).
+"""
+
+from triton_distributed_tpu.runtime.mesh import (  # noqa: F401
+    make_mesh,
+    get_default_mesh,
+    set_default_mesh,
+    initialize_distributed,
+    Topology,
+)
+from triton_distributed_tpu.runtime.platform import (  # noqa: F401
+    on_tpu,
+    resolve_interpret,
+)
+from triton_distributed_tpu.runtime.symm import (  # noqa: F401
+    SymmetricWorkspace,
+    get_workspace,
+    clear_workspaces,
+)
+from triton_distributed_tpu.runtime.utils import (  # noqa: F401
+    perf_func,
+    dist_print,
+    assert_allclose,
+)

@@ -1,0 +1,156 @@
+"""Mesh bring-up and topology introspection.
+
+TPU-native analog of the reference's runtime bring-up
+(``python/triton_dist/utils.py:174`` ``initialize_distributed`` — torchrun env →
+NCCL process group → NVSHMEM init) and its topology probes (NVLink adjacency /
+NUMA / PCIe, utils.py:587-862). On TPU the roles map to:
+
+  torchrun + NCCL rendezvous  -> ``jax.distributed.initialize()`` (multi-host)
+  NVSHMEM symmetric heap      -> per-device HBM arrays addressed by Pallas
+                                 remote DMA over ICI (see runtime/symm.py)
+  NVLink/NUMA topology probe  -> mesh axes + slice introspection (``Topology``)
+  "intra_node" comm scope     -> intra-slice ICI
+  "inter_node" comm scope     -> inter-slice DCN (XLA collectives)
+
+Axis-name conventions used across the framework:
+  dp — data parallel        tp — tensor parallel     sp — sequence/context par.
+  ep — expert parallel      pp — pipeline parallel
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import os
+from typing import Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
+
+# Canonical axis order when several parallelism axes are combined into one mesh.
+AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
+
+_default_mesh: Mesh | None = None
+
+
+def initialize_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Bring up the multi-host runtime (no-op on a single host).
+
+    Mirrors reference ``initialize_distributed`` (utils.py:174): reads launcher
+    environment (here: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID, the analog of torchrun's MASTER_ADDR/WORLD_SIZE/RANK) and
+    performs the rendezvous. The symmetric-memory bootstrap the reference does
+    via NVSHMEM UID broadcast is unnecessary on TPU: remote DMA addressing is
+    mesh-logical, established by SPMD compilation itself.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None and (env_np := os.environ.get("JAX_NUM_PROCESSES")):
+        num_processes = int(env_np)
+    if process_id is None and (env_pid := os.environ.get("JAX_PROCESS_ID")):
+        process_id = int(env_pid)
+    if coordinator_address is None and num_processes is None:
+        return  # single-host; jax.devices() already has everything local
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def make_mesh(
+    shape: Mapping[str, int] | None = None,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+    set_default: bool = True,
+) -> Mesh:
+    """Create a named device mesh.
+
+    ``shape`` maps axis names to sizes; axes with size 1 may be omitted.
+    A single remaining free factor may be given as -1 (filled with whatever
+    device count is left). Default: all devices on the ``tp`` axis — the
+    reference's default world view (one flat TP group, utils.py:190).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if shape is None:
+        shape = {"tp": n}
+    names, sizes = list(shape.keys()), list(shape.values())
+    if any(s == 0 or s < -1 for s in sizes):
+        raise ValueError(f"invalid axis sizes in mesh shape {dict(zip(names, sizes))}")
+    if sizes.count(-1) > 1:
+        raise ValueError("at most one axis size may be -1")
+    if -1 in sizes:
+        known = math.prod(s for s in sizes if s != -1)
+        if n % known:
+            raise ValueError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    if math.prod(sizes) != n:
+        raise ValueError(f"mesh shape {dict(zip(names, sizes))} != {n} devices")
+    mesh = Mesh(np.asarray(devices).reshape(sizes), tuple(names))
+    if set_default:
+        set_default_mesh(mesh)
+    return mesh
+
+
+def set_default_mesh(mesh: Mesh) -> None:
+    global _default_mesh
+    _default_mesh = mesh
+
+
+def get_default_mesh() -> Mesh:
+    """Return the default mesh, creating an all-``tp`` mesh lazily."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh(set_default=False)
+    return _default_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Static cluster topology facts (analog of utils.py:587-862 probes)."""
+
+    num_devices: int
+    num_processes: int
+    process_index: int
+    devices_per_process: int
+    platform: str
+    device_kind: str
+    num_slices: int          # DCN-connected slice count; 1 = single ICI domain
+    devices_per_slice: int
+
+    @classmethod
+    def detect(cls) -> "Topology":
+        devs = jax.devices()
+        slice_ids = sorted({getattr(d, "slice_index", 0) for d in devs})
+        num_slices = max(len(slice_ids), 1)
+        return cls(
+            num_devices=len(devs),
+            num_processes=jax.process_count(),
+            process_index=jax.process_index(),
+            devices_per_process=max(len(jax.local_devices()), 1),
+            platform=devs[0].platform,
+            device_kind=devs[0].device_kind,
+            num_slices=num_slices,
+            devices_per_slice=len(devs) // num_slices,
+        )
+
+    @property
+    def multi_slice(self) -> bool:
+        """True when the mesh spans DCN (reference's "inter_node" scope)."""
+        return self.num_slices > 1
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def ring_neighbors(rank, world: int):
+    """(left, right) neighbors on a logical ring — ICI torus wraparound makes
+    the logical ring physically contiguous on TPU, the analog of the NVLink
+    ring the reference's 1D allgather uses (kernels/nvidia/allgather.py:140)."""
+    return (rank - 1) % world, (rank + 1) % world

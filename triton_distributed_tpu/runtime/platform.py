@@ -1,0 +1,51 @@
+"""Platform detection and interpret-mode resolution.
+
+The reference runs its kernels natively on GPU and has no CPU-simulation story
+(SURVEY.md §4: "Multi-node without a cluster: not simulated"). We do better:
+every Pallas kernel in this framework takes ``interpret=None`` and resolves it
+here — on real TPU hardware kernels compile via Mosaic; anywhere else they run
+under the Pallas TPU interpreter, which supports inter-chip remote DMA and
+semaphores on a virtual CPU mesh (``--xla_force_host_platform_device_count``).
+
+This is what lets ``tests/`` validate 8-way distributed kernels on a CPU-only
+CI box, and it also provides a *race detector*
+(``pltpu.InterpretParams(detect_races=True)``) — the analog of running the
+reference under ``compute-sanitizer`` (scripts/launch.sh:169).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Union
+
+import jax
+
+InterpretFlag = Union[bool, None, Any]  # Any = pltpu.InterpretParams
+
+
+@functools.cache
+def on_tpu() -> bool:
+    """True when the default JAX backend is a real TPU (incl. tunneled)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def resolve_interpret(interpret: InterpretFlag = None, *, detect_races: bool = False):
+    """Resolve an ``interpret`` kernel argument.
+
+    - ``None``  -> interpret iff not running on real TPU hardware.
+    - ``True``/``False`` or an ``InterpretParams`` -> passed through,
+      except ``True`` is upgraded to ``InterpretParams`` so TPU-specific
+      primitives (remote DMA, semaphores) are simulated faithfully.
+    """
+    from jax.experimental.pallas import tpu as pltpu  # deferred: cheap import path
+
+    if interpret is None:
+        interpret = not on_tpu()
+    if isinstance(interpret, pltpu.InterpretParams):
+        return interpret
+    if interpret is True:
+        return pltpu.InterpretParams(detect_races=detect_races)
+    return interpret  # explicit False: compiled path, even with detect_races

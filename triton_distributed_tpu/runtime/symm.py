@@ -1,0 +1,91 @@
+"""Symmetric workspace manager — the TPU analog of the NVSHMEM symmetric heap.
+
+Reference semantics (``python/triton_dist/utils.py:122-147``):
+``nvshmem_create_tensor(shape, dtype)`` allocates a same-shaped buffer at the
+same symmetric-heap offset on every rank, so device code can address a peer's
+copy (``get_peer_tensor``, ``dl.symm_at``). On TPU the property "every device
+has an identically-laid-out buffer reachable by one-sided DMA" is obtained
+structurally: allocate ONE array sharded over the mesh axis so every device
+holds an identical local block, and pass it into a shard_mapped Pallas kernel —
+``pltpu.make_async_remote_copy`` then addresses the peer's block by logical
+device id. No heap, no UID exchange, no pointer translation.
+
+What remains worth managing is *persistence*: overlap kernels want their
+gather/scatter scratch and signal cells allocated once per (op, shape) and
+reused across steps (reference ``create_*_context`` factories). This registry
+provides that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class SymmetricWorkspace:
+    """A persistent mesh-sharded buffer: ``array[world, *local_shape]`` with
+    device ``i`` owning slice ``[i]`` — the symmetric-tensor analog."""
+
+    name: str
+    array: jax.Array
+    axis: str
+
+    @property
+    def local_shape(self) -> Tuple[int, ...]:
+        return tuple(self.array.shape[1:])
+
+    def zero(self) -> "SymmetricWorkspace":
+        self.array = jnp.zeros_like(self.array)  # keeps the source sharding
+        return self
+
+
+_REGISTRY: Dict[tuple, SymmetricWorkspace] = {}
+
+
+def get_workspace(
+    name: str,
+    local_shape: Tuple[int, ...],
+    dtype,
+    *,
+    mesh: Mesh,
+    axis: str = "tp",
+    zero: bool = False,
+) -> SymmetricWorkspace:
+    """Get-or-create a persistent symmetric workspace.
+
+    Returns an array of global shape ``(mesh.shape[axis], *local_shape)``
+    sharded as ``P(axis)`` — each device owns one ``local_shape`` block.
+    Keyed like the reference's per-op contexts (e.g. ``create_ag_gemm_context``
+    allgather_gemm.py:489) so repeated calls at the same shape reuse memory.
+    """
+    world = mesh.shape[axis]
+    key = (name, world, tuple(local_shape), jnp.dtype(dtype), axis, id(mesh))
+    ws = _REGISTRY.get(key)
+    if ws is None:
+        sharding = NamedSharding(mesh, P(axis, *([None] * len(local_shape))))
+        arr = jax.device_put(
+            jnp.zeros((world, *local_shape), dtype=dtype), sharding
+        )
+        ws = SymmetricWorkspace(name=name, array=arr, axis=axis)
+        _REGISTRY[key] = ws
+    elif zero:
+        ws.zero()
+    return ws
+
+
+def signal_buffer(name: str, n_signals: int, *, mesh: Mesh, axis: str = "tp") -> SymmetricWorkspace:
+    """Persistent int32 signal cells, one row per rank (the analog of the
+    reference's barrier/signal symmetric tensors, e.g. allgather_gemm.py:404
+    ``barrier_bufs``). Pallas kernels flip these with remote stores; host code
+    reads them as ordinary array values."""
+    return get_workspace(f"signal:{name}", (n_signals,), jnp.int32, mesh=mesh, axis=axis)
+
+
+def clear_workspaces() -> None:
+    """Free all registered workspaces (reference ``nvshmem_free_tensor_sync``)."""
+    _REGISTRY.clear()

@@ -1,0 +1,113 @@
+"""Host-side utilities: timing, printing, numeric checking.
+
+Analog of the reference's ``python/triton_dist/utils.py`` helpers:
+``perf_func`` (:269), ``dist_print`` (:284), ``assert_allclose`` (:865).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import statistics
+import time
+from typing import Any, Callable, Iterable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _block(tree: Any) -> Any:
+    return jax.block_until_ready(tree)
+
+
+def perf_func(
+    fn: Callable[[], Any],
+    *,
+    warmup: int = 5,
+    iters: int = 20,
+    per_iter: bool = False,
+):
+    """Time ``fn`` (already arg-bound) and return ``(last_result, ms)``.
+
+    Median-of-iters wall time with device sync, the analog of the reference's
+    CUDA-event timing in ``perf_func`` (utils.py:269). ``fn`` should be jitted;
+    warmup triggers compilation.
+    """
+    result = None
+    for _ in range(max(warmup, 1)):
+        result = _block(fn())
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        result = _block(fn())
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = statistics.median(times)
+    if per_iter:
+        return result, ms, times
+    return result, ms
+
+
+def dist_print(*args, allowed_ranks: Iterable[int] | str = "all", **kwargs):
+    """Process-index-prefixed print (reference ``dist_print`` utils.py:284)."""
+    rank = jax.process_index()
+    if allowed_ranks != "all" and rank not in set(allowed_ranks):
+        return
+    print(f"[rank{rank}]", *args, **kwargs)
+
+
+_DTYPE_TOL = {
+    jnp.float32.dtype: (1e-5, 1.5e-2),
+    jnp.bfloat16.dtype: (1e-2, 1e-1),
+    jnp.float16.dtype: (1e-3, 1e-2),
+}
+
+
+def assert_allclose(actual, expected, *, atol=None, rtol=None, msg=""):
+    """Dtype-aware allclose with a readable failure report
+    (reference utils.py:865)."""
+    actual_j = jax.device_get(actual)
+    expected_j = jax.device_get(expected)
+    # Tolerance follows the coarser of the two dtypes (a bf16 actual vs fp32
+    # golden must get bf16 tolerances).
+    tols = [
+        _DTYPE_TOL.get(getattr(x, "dtype", None), (1e-5, 1e-5))
+        for x in (actual_j, expected_j)
+    ]
+    d_atol = max(t[0] for t in tols)
+    d_rtol = max(t[1] for t in tols)
+    atol = d_atol if atol is None else atol
+    rtol = d_rtol if rtol is None else rtol
+    actual = np.asarray(actual_j, dtype=np.float32)
+    expected = np.asarray(expected_j, dtype=np.float32)
+    if actual.shape != expected.shape:
+        raise AssertionError(f"shape mismatch {actual.shape} vs {expected.shape} {msg}")
+    err = np.abs(actual - expected)
+    bound = atol + rtol * np.abs(expected)
+    bad = err > bound
+    if bad.any():
+        idx = np.unravel_index(np.argmax(err - bound), err.shape)
+        raise AssertionError(
+            f"allclose failed {msg}: {bad.sum()}/{bad.size} elements "
+            f"(worst at {idx}: got {actual[idx]}, want {expected[idx]}, "
+            f"|err|={err[idx]:.3e}, atol={atol}, rtol={rtol})"
+        )
+
+
+@contextlib.contextmanager
+def group_profile(name: str = "trace", *, enabled: bool = True, dir: str = "/tmp/tdtpu_trace"):
+    """Profiling context (analog of reference ``group_profile`` utils.py:500).
+
+    The reference merges per-rank chrome traces by hand; on TPU
+    ``jax.profiler`` already captures every local device into one XPlane trace,
+    so the cross-rank merge reduces to each process writing
+    ``{dir}/{name}/p{process_index}``, viewable together in XProf/Perfetto.
+    """
+    if not enabled:
+        yield
+        return
+    path = f"{dir}/{name}/p{jax.process_index()}"
+    jax.profiler.start_trace(path)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
