@@ -23,17 +23,20 @@ M, K, N_PER_RANK = 4096, 5120, 3200
 def main():
     from triton_distributed_tpu.runtime.utils import perf_func
 
-    a = jnp.ones((M, K), jnp.bfloat16)
-    b = jnp.ones((K, N_PER_RANK), jnp.bfloat16)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (M, K), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (K, N_PER_RANK), jnp.bfloat16)
 
     try:
         from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip
 
-        fn = jax.jit(lambda: ag_gemm_single_chip(a, b))
-    except ImportError:
-        fn = jax.jit(lambda: jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+        fn = jax.jit(ag_gemm_single_chip)
+    except ModuleNotFoundError as e:
+        if e.name and not e.name.startswith("triton_distributed_tpu"):
+            raise
+        fn = jax.jit(lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
 
-    _, ms = perf_func(fn, warmup=5, iters=50)
+    _, ms = perf_func(lambda: fn(a, b), warmup=5, iters=50)
     print(json.dumps({
         "metric": "ag_gemm_m4096_qwen32b_tp8_ms",
         "value": round(ms, 4),

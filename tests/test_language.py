@@ -1,0 +1,125 @@
+"""Primitive-layer tests — the analog of the reference's
+test_distributed_wait.py / test_notify.py / test_nvshmem_api.py / test_ring_put.py,
+run 8-way on the virtual CPU mesh under the Pallas interpreter."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import PartitionSpec as P
+
+import triton_distributed_tpu.language as dl
+from triton_distributed_tpu.runtime import assert_allclose, resolve_interpret
+
+
+def shard_run(kernel_fn, mesh, x, *, out_shape, scratch_shapes=(), collective_id=0,
+              out_space=pl.ANY):
+    """Run a Pallas kernel under shard_map over the ``tp`` axis.
+
+    ``x`` has global shape ``(world, *local)``; the kernel sees the ``local``
+    block. Returns global ``(world, *out_local)``.
+    """
+
+    def per_device(xl):
+        out = pl.pallas_call(
+            kernel_fn,
+            out_shape=out_shape,
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=pl.BlockSpec(memory_space=out_space),
+            scratch_shapes=list(scratch_shapes),
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=collective_id
+            ),
+            interpret=resolve_interpret(None),
+        )(xl[0])
+        return out[None]
+
+    in_spec = P("tp", *([None] * (x.ndim - 1)))
+    out_spec = P("tp", *([None] * len(out_shape.shape)))
+    f = jax.jit(
+        jax.shard_map(
+            per_device, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+            check_vma=False,
+        )
+    )
+    return f(x)
+
+
+def test_rank_num_ranks(mesh8):
+    def kernel(x_ref, o_ref):
+        o_ref[0, 0] = dl.rank("tp")
+        o_ref[0, 1] = dl.num_ranks("tp")
+
+    x = jnp.zeros((8, 1), jnp.int32)
+    out = shard_run(
+        kernel, mesh8, x, out_shape=jax.ShapeDtypeStruct((1, 2), jnp.int32),
+        out_space=pltpu.VMEM,
+    )
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], np.arange(8))
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 1], np.full(8, 8))
+
+
+def test_notify_wait_neighbor(mesh8):
+    """Each device notifies its right neighbor's barrier semaphore and waits
+    for its left neighbor — a 1-hop handshake (reference test_notify.py)."""
+
+    def kernel(x_ref, o_ref):
+        right = dl.remote_rank(1)
+        sem = pltpu.get_barrier_semaphore()
+        dl.notify(sem, right)
+        dl.wait(sem, 1)
+        o_ref[0, 0] = dl.rank("tp") + 100
+
+    x = jnp.zeros((8, 1), jnp.int32)
+    out = shard_run(
+        kernel, mesh8, x, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        collective_id=1, out_space=pltpu.VMEM,
+    )
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], np.arange(8) + 100)
+
+
+def test_ring_put(mesh8):
+    """Each device puts its local block into its right neighbor's output
+    (reference test_ring_put.py): out[r] == x[(r-1) % world]."""
+
+    def kernel(x_ref, o_ref, send_sem, recv_sem):
+        right = dl.remote_rank(1)
+        dma = dl.putmem_signal_nbi(x_ref, o_ref, right, send_sem, recv_sem)
+        dma.wait_send()
+        dl.wait_dma_arrival(o_ref, recv_sem)  # data from left neighbor arrived
+
+    x = jnp.arange(8 * 4 * 128, dtype=jnp.float32).reshape(8, 4, 128)
+    out = shard_run(
+        kernel, mesh8, x,
+        out_shape=jax.ShapeDtypeStruct((4, 128), jnp.float32),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(())],
+        collective_id=2,
+    )
+    expected = np.roll(np.asarray(x), shift=1, axis=0)
+    assert_allclose(out, expected)
+
+
+def test_barrier_all(mesh8):
+    def kernel(x_ref, o_ref):
+        dl.barrier_all("tp")
+        o_ref[0, 0] = jnp.int32(1)
+
+    x = jnp.zeros((8, 1), jnp.int32)
+    out = shard_run(
+        kernel, mesh8, x, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        collective_id=3, out_space=pltpu.VMEM,
+    )
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], np.ones(8))
+
+
+def test_signal_add_only():
+    with pytest.raises(NotImplementedError):
+        dl.notify(None, 0, sig_op=dl.SIGNAL_SET)
+
+
+def test_consume_token_identity():
+    assert dl.consume_token(5, token=None) == 5

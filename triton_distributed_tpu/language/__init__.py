@@ -1,0 +1,41 @@
+"""Device-side language layer (L5): the reference's ``triton_dist.language``
+re-based on Pallas/Mosaic.
+
+The reference needed an MLIR ``Distributed`` dialect because Triton had no
+communication ops (SURVEY.md §2.1). Pallas already exposes semaphores and
+inter-chip remote DMA, so this layer is a thin, semantics-preserving Python
+API — every primitive documents the reference op it mirrors.
+
+Import convention inside kernels (mirrors ``import triton_dist.language as dl``):
+
+    import triton_distributed_tpu.language as dl
+
+    def kernel(...):
+        r = dl.rank("tp")
+        dl.notify(sem, peer_rank)
+        dl.wait(sem, 1)
+"""
+
+from triton_distributed_tpu.language.primitives import (  # noqa: F401
+    rank,
+    num_ranks,
+    wait,
+    notify,
+    consume_token,
+    barrier_all,
+    SIGNAL_SET,
+    SIGNAL_ADD,
+)
+from triton_distributed_tpu.language.shmem import (  # noqa: F401
+    my_pe,
+    n_pes,
+    remote_rank,
+    putmem_nbi,
+    putmem_signal_nbi,
+    putmem_block,
+    signal_op,
+    signal_wait_until,
+    wait_dma_arrival,
+    quiet,
+    fence,
+)

@@ -1,0 +1,110 @@
+"""Core distributed primitives: rank/num_ranks/wait/notify/consume_token/barrier.
+
+Semantics mapping from the reference's Distributed dialect
+(include/TritonDistributed/Dialect/Distributed/IR/DistributedOps.td):
+
+  GetRankOp (td:113)      -> ``rank(axis)``  = jax.lax.axis_index
+  GetNumRanksOp (td:124)  -> ``num_ranks(axis)`` = jax.lax.axis_size
+  WaitOp (td:45)          -> ``wait(sem, value)`` = pltpu.semaphore_wait.
+      The reference spin-waits on barrier *cells* in symmetric memory with an
+      acquire/relaxed scope lattice (cta/gpu/sys — DistributedOpToLLVM.cpp:146).
+      TPU semaphores are hardware-synchronizing: a successful wait orders all
+      DMA effects tracked by that semaphore, so the scope/semantic arguments
+      collapse and are accepted only for API parity.
+  NotifyOp (td:151)       -> ``notify(sem, peer, axis=...)`` =
+      pltpu.semaphore_signal with a logical device id (the reference's
+      membar+st.relaxed / nvshmemx_signal_op split is subsumed by the
+      semaphore network).
+  ConsumeTokenOp (td:79)  -> ``consume_token(value, token)``: the reference
+      builds an artificial data dependence so the compiler cannot hoist loads
+      above a wait. In Pallas, memory ops are ordered with semaphore waits by
+      Mosaic program order, so this is the identity — kept so ported kernels
+      read the same.
+  SymmAtOp (td:135)       -> no pointer translation exists on TPU; remote
+      addressing happens inside ``shmem.putmem_*`` via logical device ids.
+
+Signal op enum (DistributedAttrDefs.td): SIGNAL_SET / SIGNAL_ADD. TPU
+semaphores only add; SET is emulated where needed at the buffer level.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+SIGNAL_SET = "set"
+SIGNAL_ADD = "add"
+
+
+def rank(axis: str = "tp"):
+    """This device's index along a mesh axis (dl.rank, distributed_ops.py:88)."""
+    return jax.lax.axis_index(axis)
+
+
+def num_ranks(axis: str = "tp"):
+    """World size along a mesh axis (dl.num_ranks, distributed_ops.py:94)."""
+    return jax.lax.axis_size(axis)
+
+
+def wait(sem_ref, value: int = 1, *, scope: str = "gpu", semantic: str = "acquire"):
+    """Block until ``sem_ref`` has accumulated ``value``; decrements by
+    ``value`` on success (dl.wait, distributed_ops.py:56).
+
+    ``scope``/``semantic`` are accepted for parity and ignored: TPU semaphore
+    waits are chip-scoped and acquire-ordered by construction.
+    """
+    del scope, semantic
+    pltpu.semaphore_wait(sem_ref, value)
+
+
+def notify(sem_ref, peer=None, *, inc: int = 1, sig_op: str = SIGNAL_ADD,
+           comm_scope: str = "intra_node"):
+    """Signal a (possibly remote) semaphore (dl.notify, distributed_ops.py:107).
+
+    ``peer=None`` signals the local semaphore. TPU semaphores accumulate, so
+    only SIGNAL_ADD is supported natively; the scope argument is parity-only —
+    ICI reaches every device in the mesh axis.
+    """
+    del comm_scope
+    if sig_op != SIGNAL_ADD:
+        raise NotImplementedError(
+            "TPU semaphores accumulate; use SIGNAL_ADD (emulate SET at the "
+            "buffer level if needed)"
+        )
+    if peer is None:
+        pltpu.semaphore_signal(sem_ref, inc=inc)
+    else:
+        pltpu.semaphore_signal(
+            sem_ref, inc=inc, device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+
+def consume_token(value, token=None):
+    """Identity; see module docstring (dl.consume_token, distributed_ops.py:77)."""
+    del token
+    return value
+
+
+def barrier_all(axis: str = "tp"):
+    """Full barrier across a mesh axis, inside a Pallas kernel.
+
+    Analog of ``barrier_all_intra_node_*`` (kernels/nvidia/common_ops.py:135)
+    and the device-side ``nvshmem_barrier_all_block``. Uses the global barrier
+    semaphore: every device signals every other device once, then waits for
+    world-1 signals. Requires ``collective_id`` in CompilerParams.
+    """
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    barrier_sem = pltpu.get_barrier_semaphore()
+
+    def signal_peer(i, _):
+        peer = jax.lax.rem(me + 1 + i, world)
+        pltpu.semaphore_signal(
+            barrier_sem, inc=1, device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        return _
+
+    jax.lax.fori_loop(0, world - 1, signal_peer, None)
+    pltpu.semaphore_wait(barrier_sem, world - 1)

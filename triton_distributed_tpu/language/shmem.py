@@ -1,0 +1,112 @@
+"""SHMEM-style one-sided device API over ICI remote DMA.
+
+Analog of the reference's portable device API
+(``python/triton_dist/language/extra/libshmem_device.py``, backed on NVIDIA by
+``backends/nvidia/language/cuda/libnvshmem_device.py``): pe queries, put
+(blocking / non-blocking), put-with-signal, signal ops, quiet/fence.
+
+Key semantic differences, by hardware design:
+
+- **Push-only.** ICI remote DMA transfers local->remote; there is no
+  device-initiated remote *read* (``getmem_*``). All kernels in this framework
+  are written push-style — the reference's own high-performance paths
+  (low_latency_all_to_all.py, allgather push rings) are push-style too.
+- **Signals are semaphores.** ``putmem_signal``'s signal cell maps to the
+  remote-DMA ``recv_sem``: the receiver's wait on that semaphore *is* the
+  data-arrival guarantee (the reference needed explicit membar + signal
+  ordering, DistributedOpToLLVM.cpp:233).
+- **quiet/fence.** NVSHMEM ``quiet`` waits for all outstanding puts of the
+  calling PE; here DMA completion is tracked per-descriptor by ``send_sem``,
+  so ``quiet`` waits the handles you give it. ``fence`` (ordering between
+  puts to the same PE) is subsumed: waits are explicit.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language.primitives import rank as my_pe  # noqa: F401
+from triton_distributed_tpu.language.primitives import num_ranks as n_pes  # noqa: F401
+
+
+def remote_rank(offset: int | object, axis: str = "tp"):
+    """Logical rank at ``(me + offset) % world`` — the ring-addressing helper
+    used throughout the reference's ring kernels (allgather.py:81-140)."""
+    world = jax.lax.axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    return jax.lax.rem(me + offset + world, world)
+
+
+def putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem):
+    """Non-blocking put: start an async remote copy ``src_ref -> dst_ref`` on
+    device ``peer``; returns the DMA descriptor (wait with ``.wait()`` or
+    ``quiet``). Analog of ``nvshmem_putmem_nbi_block``
+    (libnvshmem_device.py put family)."""
+    dma = pltpu.make_async_remote_copy(
+        src_ref=src_ref,
+        dst_ref=dst_ref,
+        send_sem=send_sem,
+        recv_sem=recv_sem,
+        device_id=peer,
+        device_id_type=pltpu.DeviceIdType.LOGICAL,
+    )
+    dma.start()
+    return dma
+
+
+def putmem_signal_nbi(src_ref, dst_ref, peer, send_sem, recv_sem):
+    """Put-with-signal: identical to ``putmem_nbi`` — the receive semaphore IS
+    the signal (see module docstring). Named separately for parity with
+    ``nvshmem_putmem_signal_nbi_block`` so ported kernels keep their shape."""
+    return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem)
+
+
+def putmem_block(src_ref, dst_ref, peer, send_sem, recv_sem):
+    """Blocking put: start and wait for *local* completion (source reusable).
+    The remote side still observes arrival via ``recv_sem``."""
+    dma = putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem)
+    dma.wait_send()
+    return dma
+
+
+def signal_op(sem_ref, peer=None, *, inc: int = 1):
+    """Raise a (remote) signal: ``nvshmemx_signal_op`` analog."""
+    from triton_distributed_tpu.language.primitives import notify
+
+    notify(sem_ref, peer, inc=inc)
+
+
+def signal_wait_until(sem_ref, value: int):
+    """Wait until the signal reaches ``value`` (``nvshmem_signal_wait_until``).
+    Decrements by ``value`` — TPU semaphores are consuming; callers that poll
+    the same cell repeatedly should re-signal or track epochs.
+
+    Only REGULAR/BARRIER semaphores can be waited this way; for the arrival of
+    a ``putmem_*`` transfer (DMA ``recv_sem``) use ``wait_dma_arrival`` or the
+    symmetric descriptor's ``.wait_recv()``."""
+    pltpu.semaphore_wait(sem_ref, value)
+
+
+def wait_dma_arrival(dst_ref, recv_sem):
+    """Block until an incoming remote DMA targeting ``dst_ref`` has fully
+    arrived (its sender signalled ``recv_sem``). Implemented as a
+    descriptor-shaped wait: the byte count to await is taken from ``dst_ref``.
+
+    This is the receiver half of ``putmem_signal`` — the reference's
+    ``nvshmem_signal_wait_until(sig_addr, NVSHMEM_CMP_EQ, v)`` on the data
+    signal (low_latency_all_to_all.py handshake)."""
+    pltpu.make_async_copy(dst_ref, dst_ref, recv_sem).wait()
+
+
+def quiet(*dmas):
+    """Wait for local completion of the given outstanding puts
+    (``nvshmem_quiet`` analog, scoped to explicit handles)."""
+    for dma in dmas:
+        dma.wait_send()
+
+
+def fence():
+    """No-op: ICI DMAs tracked by distinct semaphores are ordered by explicit
+    waits; kept for API parity (``nvshmem_fence``)."""
+    return None

@@ -28,9 +28,6 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 
-# Canonical axis order when several parallelism axes are combined into one mesh.
-AXIS_ORDER = ("dp", "pp", "ep", "sp", "tp")
-
 _default_mesh: Mesh | None = None
 
 

@@ -56,7 +56,7 @@ def dist_print(*args, allowed_ranks: Iterable[int] | str = "all", **kwargs):
 
 
 _DTYPE_TOL = {
-    jnp.float32.dtype: (1e-5, 1.5e-2),
+    jnp.float32.dtype: (1e-5, 1e-5),
     jnp.bfloat16.dtype: (1e-2, 1e-1),
     jnp.float16.dtype: (1e-3, 1e-2),
 }
