@@ -4,6 +4,15 @@ All distributed kernels run under the Pallas TPU interpreter on CPU devices
 (remote DMA + semaphores are simulated faithfully), so the full 8-way
 distributed test suite runs on a CPU-only box — the simulation story the
 reference lacks (SURVEY.md §4).
+
+IMPORTANT — interpreter buffer-size ceiling: on a single-core host, the
+Pallas TPU interpreter deadlocks when a kernel that blocks on cross-device
+semaphores also allocates any per-device buffer >= 16KB (the interpreter's
+per-device threads park inside io_callbacks awaiting buffer transfers that
+the CPU client's lone async thread — busy running a blocked callback — can
+never service; verified empirically: <=12KB always passes, >=16KB always
+hangs). Keep every input/output/scratch buffer in distributed-kernel tests
+<= 12KB per device. Compiled TPU execution has no such limit.
 """
 
 import os

@@ -1,0 +1,20 @@
+"""Pallas collective & overlap kernel library (L6 analog of the reference's
+``python/triton_dist/kernels/``)."""
+
+from triton_distributed_tpu.kernels.allgather import (  # noqa: F401
+    AllGatherMethod,
+    all_gather,
+    ring_all_gather,
+    a2a_all_gather,
+)
+from triton_distributed_tpu.kernels.reduce_scatter import (  # noqa: F401
+    reduce_scatter,
+    ring_reduce_scatter,
+    oneshot_reduce_scatter,
+)
+from triton_distributed_tpu.kernels.allreduce import (  # noqa: F401
+    AllReduceMethod,
+    all_reduce,
+    oneshot_all_reduce,
+    twoshot_all_reduce,
+)

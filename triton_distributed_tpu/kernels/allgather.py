@@ -1,0 +1,218 @@
+"""AllGather kernels over ICI remote DMA.
+
+TPU-native analog of the reference's ``kernels/nvidia/allgather.py`` (593 LoC):
+its ``AllGatherMethod`` enum (allgather.py:46 — Auto/All2All/Ring1D/Ring2D/
+RingNuma2D) and the copy-engine push rings (``cp_engine_producer_all_gather_
+intra_node`` allgather.py:263, per-segment ``set_signal``/``wait_eq``).
+
+Design (not a translation):
+- The reference drives allgather with host-issued ``cudaMemcpyAsync`` on comm
+  streams, synchronized by signal cells in symmetric memory. On TPU the copy
+  engine analog is the per-chip DMA engines, driven *from inside one Pallas
+  kernel*: each device starts remote DMAs over ICI and waits per-segment
+  receive semaphores — the semaphore IS the signal cell (language/shmem.py).
+- ``Ring1D`` maps to the ICI torus wraparound ring: at step s every device
+  forwards the chunk it received at step s-1 to its right neighbor; world-1
+  steps, each link carries each chunk exactly once (bandwidth-optimal).
+- ``All2All`` maps to direct pushes to every peer (world-1 concurrent DMAs;
+  torus routing spreads them over links) — lower latency for small messages,
+  the same trade the reference makes (allgather.py:46 method choice).
+- 2D / NUMA variants become intra-slice ICI ring + inter-slice DCN; the DCN
+  leg routes through XLA collectives (see SURVEY.md §5 backend mapping) and
+  lands with multi-slice support.
+
+Each kernel is exposed two ways:
+- a *per-device* function (``ring_all_gather``/``a2a_all_gather``) callable
+  inside any ``shard_map`` — the composable form used by overlap ops;
+- a host-level ``all_gather(x_stacked, mesh=...)`` wrapper for standalone use
+  and tests, taking the symmetric-workspace stacked convention
+  ``(world, *local)`` (runtime/symm.py) and returning the gathered array.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+class AllGatherMethod(enum.Enum):
+    """Reference parity: allgather.py:46. 2D variants pending multi-slice."""
+
+    AUTO = "auto"
+    ALL2ALL = "all2all"
+    RING_1D = "ring_1d"
+
+
+def choose_all_gather_method(world: int, nbytes: int) -> AllGatherMethod:
+    """Latency/bandwidth heuristic (analog of ``get_auto_all_gather_method``,
+    allgather.py:57): small messages prefer direct pushes (one hop count,
+    world-1 concurrent DMAs), large messages prefer the ring (each ICI link
+    carries each byte once)."""
+    if world <= 2:
+        return AllGatherMethod.ALL2ALL
+    return AllGatherMethod.ALL2ALL if nbytes <= (1 << 20) else AllGatherMethod.RING_1D
+
+
+# ---------------------------------------------------------------------------
+# Ring 1D
+# ---------------------------------------------------------------------------
+
+
+def _ring_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
+                    world: int):
+    me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0]
+    right = jax.lax.rem(me + 1, world)
+
+    # All devices must have entered the kernel (so o_ref is live everywhere)
+    # before anyone pushes into a peer's o_ref.
+    dl.barrier_all(axis)
+
+    # Own shard into its slot.
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+
+    sends = []
+    for s in range(world - 1):
+        src = jax.lax.rem(me - s + world, world)  # chunk forwarded at step s
+        dma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[pl.ds(src * m, m)],
+            dst_ref=o_ref.at[pl.ds(src * m, m)],
+            send_sem=send_sems.at[s],
+            recv_sem=recv_sems.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        sends.append(dma)
+        # Chunk (me-1-s) arrives from the left at step s; it is what we
+        # forward at step s+1, so the wait doubles as the send dependency.
+        rsrc = jax.lax.rem(me - 1 - s + world, world)
+        common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], recv_sems.at[s])
+    for dma in sends:
+        dma.wait_send()
+
+
+# ---------------------------------------------------------------------------
+# All2All (direct push)
+# ---------------------------------------------------------------------------
+
+
+def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
+                   world: int):
+    me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0]
+
+    dl.barrier_all(axis)
+
+    sends = []
+    for i in range(world - 1):
+        peer = jax.lax.rem(me + 1 + i, world)
+        # Receiver waits slot ``src``; we are src ``me`` on every peer.
+        dma = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=o_ref.at[pl.ds(me * m, m)],
+            send_sem=send_sems.at[i],
+            recv_sem=recv_sems.at[me],
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        sends.append(dma)
+
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+
+    for i in range(world - 1):
+        src = jax.lax.rem(me + 1 + i, world)
+        common.wait_recv(o_ref.at[pl.ds(src * m, m)], recv_sems.at[src])
+    for dma in sends:
+        dma.wait_send()
+
+
+# ---------------------------------------------------------------------------
+# Per-device entry points (usable inside shard_map)
+# ---------------------------------------------------------------------------
+
+
+def _ag_call(kernel, x_local, *, axis: str, interpret, collective_id: int):
+    world = jax.lax.axis_size(axis)
+    if world == 1:
+        return x_local
+    m = x_local.shape[0]
+    return common.make_pallas_call(
+        functools.partial(kernel, axis=axis, world=world),
+        out_shape=jax.ShapeDtypeStruct((world * m, *x_local.shape[1:]),
+                                       x_local.dtype),
+        in_specs=[common.any_spec()],
+        out_specs=common.any_spec(),
+        scratch_shapes=[
+            common.dma_sems(world - 1),   # send
+            common.dma_sems(world),       # recv (slot-per-src; ring uses [:world-1])
+            pltpu.SemaphoreType.DMA(()),  # local copy
+        ],
+        collective_id=collective_id,
+        interpret=interpret,
+    )(x_local)
+
+
+def ring_all_gather(x_local, *, axis: str = "tp", interpret=None):
+    """Bandwidth-optimal ring allgather of ``x_local (m, ...)`` along ``axis``
+    → ``(world*m, ...)``, segment ``r`` holding rank ``r``'s shard."""
+    return _ag_call(_ring_ag_kernel, x_local, axis=axis, interpret=interpret,
+                    collective_id=common.collective_id_for("ag_ring"))
+
+
+def a2a_all_gather(x_local, *, axis: str = "tp", interpret=None):
+    """Latency-optimal direct-push allgather (see module docstring)."""
+    return _ag_call(_a2a_ag_kernel, x_local, axis=axis, interpret=interpret,
+                    collective_id=common.collective_id_for("ag_a2a"))
+
+
+# ---------------------------------------------------------------------------
+# Host-level wrapper
+# ---------------------------------------------------------------------------
+
+
+def all_gather(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
+               method: AllGatherMethod | str = AllGatherMethod.AUTO,
+               interpret=None):
+    """Standalone allgather over a mesh axis.
+
+    ``x_stacked``: global ``(world, *local)`` array, device ``r`` owning slice
+    ``[r]`` (the symmetric-workspace convention). Returns the gathered
+    ``(world * local[0], *local[1:])`` array (replicated).
+    """
+    mesh = mesh or get_default_mesh()
+    world = mesh.shape[axis]
+    if isinstance(method, str):
+        method = AllGatherMethod(method)
+    if method is AllGatherMethod.AUTO:
+        method = choose_all_gather_method(world, x_stacked.nbytes // world)
+    return _build_ag(mesh, axis, method, interpret, x_stacked.ndim - 1)(x_stacked)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ag(mesh, axis, method, interpret, nd):
+    """Jit-cached wrapper builder (jit caches by callable identity, so the
+    callable must be built once per (mesh, axis, method) — not per call)."""
+    per_device = ring_all_gather if method is AllGatherMethod.RING_1D else a2a_all_gather
+
+    def f(xs):  # xs: (1, *local)
+        return per_device(xs[0], axis=axis, interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=P(axis, *([None] * nd)),
+            out_specs=P(*([None] * nd)),
+            check_vma=False,
+        )
+    )

@@ -1,0 +1,257 @@
+"""AllReduce kernels over ICI remote DMA.
+
+TPU-native analog of the reference's ``kernels/nvidia/allreduce.py`` (1102 LoC:
+one-shot push :364, two-shot :476, double-tree :223, multimem :633) and its
+method enum (``kernels/allreduce.py:8-31``).
+
+Method mapping (hardware-driven, per SURVEY.md §7 hard-part 3):
+- **one-shot**: every rank pushes its full buffer to all peers' staging; each
+  rank reduces locally. Latency-optimal for small buffers — the role the
+  reference's one-shot/multimem variants play. (No NVLink-SHARP/multimem
+  analog exists on ICI, so the multicast variants collapse into this.)
+- **two-shot**: ring reduce-scatter then ring allgather, fused in one Pallas
+  kernel so the AG leg reuses the RS kernel's semaphores and staging —
+  bandwidth-optimal (2·(world-1)/world · bytes per link), the same structure
+  as the reference's two-shot (:476).
+- **double-tree**: a latency/bandwidth middle ground on NVLink; on a wrapped
+  ICI torus the ring already achieves link-optimality, so the tree variant is
+  intentionally not carried over.
+
+Per-device forms compose inside ``shard_map``; host wrapper ``all_reduce``
+takes stacked ``(world, m, ...)`` inputs and returns the reduced ``(m, ...)``.
+"""
+
+from __future__ import annotations
+
+import enum
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+class AllReduceMethod(enum.Enum):
+    """Reference parity: kernels/allreduce.py:8-31 (multimem/double-tree fold
+    into these two on ICI — see module docstring)."""
+
+    AUTO = "auto"
+    ONE_SHOT = "one_shot"
+    TWO_SHOT = "two_shot"
+
+
+def choose_all_reduce_method(world: int, nbytes: int, leading_dim: int) -> AllReduceMethod:
+    """One-shot moves (world-1)·n bytes out per rank but finishes in one hop;
+    two-shot moves 2·(world-1)/world·n per link over 2(world-1) latency hops.
+    Crossover mirrors the reference's auto dispatch (small → one-shot).
+    Two-shot additionally needs the leading dim divisible by world."""
+    if nbytes <= (1 << 20) or world <= 2 or leading_dim % world:
+        return AllReduceMethod.ONE_SHOT
+    return AllReduceMethod.TWO_SHOT
+
+
+# ---------------------------------------------------------------------------
+# One-shot
+# ---------------------------------------------------------------------------
+
+
+def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
+                       acc_ref, tmp_ref, out_vmem, *, axis: str, world: int):
+    me = jax.lax.axis_index(axis)
+
+    dl.barrier_all(axis)
+
+    sends = []
+    for i in range(world - 1):
+        peer = jax.lax.rem(me + 1 + i, world)
+        dma = pltpu.make_async_remote_copy(
+            src_ref=x_ref,
+            dst_ref=staging.at[me],
+            send_sem=send_sems.at[i],
+            recv_sem=recv_sems.at[me],
+            device_id=peer,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        sends.append(dma)
+
+    common.local_copy(x_ref, tmp_ref, copy_sem)
+    acc_ref[...] = tmp_ref[...].astype(jnp.float32)
+
+    for i in range(world - 1):
+        src = jax.lax.rem(me + 1 + i, world)
+        common.wait_recv(staging.at[src], recv_sems.at[src])
+        common.local_copy(staging.at[src], tmp_ref, copy_sem)
+        acc_ref[...] += tmp_ref[...].astype(jnp.float32)
+
+    out_vmem[...] = acc_ref[...].astype(out_vmem.dtype)
+    common.local_copy(out_vmem, o_ref, copy_sem)
+    for dma in sends:
+        dma.wait_send()
+
+
+def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
+    """Latency-optimal allreduce of ``x_local (m, ...)`` along ``axis``."""
+    world = jax.lax.axis_size(axis)
+    if world == 1:
+        return x_local
+    shape = x_local.shape
+    return common.make_pallas_call(
+        functools.partial(_oneshot_ar_kernel, axis=axis, world=world),
+        out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
+        in_specs=[common.any_spec()],
+        out_specs=common.any_spec(),
+        scratch_shapes=[
+            pltpu.HBM((world, *shape), x_local.dtype),
+            common.dma_sems(world),
+            common.dma_sems(world),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM(shape, jnp.float32),
+            pltpu.VMEM(shape, x_local.dtype),
+            pltpu.VMEM(shape, x_local.dtype),
+        ],
+        collective_id=common.collective_id_for("ar_oneshot"),
+        interpret=interpret,
+    )(x_local)
+
+
+# ---------------------------------------------------------------------------
+# Two-shot: fused ring RS + ring AG in one kernel.
+# ---------------------------------------------------------------------------
+
+
+def _twoshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems,
+                       ag_send_sems, ag_recv_sems, copy_sem, tmp_ref, send_buf,
+                       *, axis: str, world: int):
+    me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0] // world
+    right = jax.lax.rem(me + 1, world)
+
+    dl.barrier_all(axis)
+
+    # --- reduce-scatter leg (ring; see reduce_scatter._ring_rs_kernel) ---
+    for s in range(world - 1):
+        c = jax.lax.rem(me - s - 1 + world, world)
+        common.local_copy(x_ref.at[pl.ds(c * m, m)], tmp_ref, copy_sem)
+        acc = tmp_ref[...].astype(jnp.float32)
+        if s > 0:
+            common.wait_recv(staging.at[s - 1], recv_sems.at[s - 1])
+            common.local_copy(staging.at[s - 1], tmp_ref, copy_sem)
+            acc += tmp_ref[...].astype(jnp.float32)
+        send_buf[...] = acc.astype(send_buf.dtype)
+        dma = pltpu.make_async_remote_copy(
+            src_ref=send_buf, dst_ref=staging.at[s],
+            send_sem=send_sems.at[s], recv_sem=recv_sems.at[s],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        dma.wait_send()
+
+    common.local_copy(x_ref.at[pl.ds(me * m, m)], tmp_ref, copy_sem)
+    acc = tmp_ref[...].astype(jnp.float32)
+    common.wait_recv(staging.at[world - 2], recv_sems.at[world - 2])
+    common.local_copy(staging.at[world - 2], tmp_ref, copy_sem)
+    acc += tmp_ref[...].astype(jnp.float32)
+    send_buf[...] = acc.astype(send_buf.dtype)
+    # Own fully-reduced segment into place.
+    common.local_copy(send_buf, o_ref.at[pl.ds(me * m, m)], copy_sem)
+
+    # --- allgather leg (ring; see allgather._ring_ag_kernel) ---
+    sends = []
+    for s in range(world - 1):
+        src = jax.lax.rem(me - s + world, world)
+        dma = pltpu.make_async_remote_copy(
+            src_ref=o_ref.at[pl.ds(src * m, m)],
+            dst_ref=o_ref.at[pl.ds(src * m, m)],
+            send_sem=ag_send_sems.at[s],
+            recv_sem=ag_recv_sems.at[s],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        dma.start()
+        sends.append(dma)
+        rsrc = jax.lax.rem(me - 1 - s + world, world)
+        common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], ag_recv_sems.at[s])
+    for dma in sends:
+        dma.wait_send()
+
+
+def twoshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
+    """Bandwidth-optimal allreduce (ring RS + ring AG fused in one kernel).
+    Requires ``x_local.shape[0]`` divisible by world."""
+    world = jax.lax.axis_size(axis)
+    if world == 1:
+        return x_local
+    if x_local.shape[0] % world:
+        raise ValueError(
+            f"two-shot allreduce needs leading dim {x_local.shape[0]} divisible "
+            f"by world {world}; use one-shot or pad")
+    shape = x_local.shape
+    m = shape[0] // world
+    rest = shape[1:]
+    return common.make_pallas_call(
+        functools.partial(_twoshot_ar_kernel, axis=axis, world=world),
+        out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
+        in_specs=[common.any_spec()],
+        out_specs=common.any_spec(),
+        scratch_shapes=[
+            pltpu.HBM((world - 1, m, *rest), x_local.dtype),
+            common.dma_sems(world - 1),
+            common.dma_sems(world - 1),
+            common.dma_sems(world - 1),
+            common.dma_sems(world - 1),
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((m, *rest), x_local.dtype),
+            pltpu.VMEM((m, *rest), x_local.dtype),
+        ],
+        collective_id=common.collective_id_for("ar_twoshot"),
+        interpret=interpret,
+    )(x_local)
+
+
+# ---------------------------------------------------------------------------
+# Host-level wrapper
+# ---------------------------------------------------------------------------
+
+
+def all_reduce(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
+               method: AllReduceMethod | str = AllReduceMethod.AUTO,
+               interpret=None):
+    """Standalone allreduce over a mesh axis.
+
+    ``x_stacked``: global ``(world, m, ...)``, device ``r`` holding its
+    contribution ``[r]``. Returns the reduced ``(m, ...)`` (replicated).
+    """
+    mesh = mesh or get_default_mesh()
+    world = mesh.shape[axis]
+    if isinstance(method, str):
+        method = AllReduceMethod(method)
+    if method is AllReduceMethod.AUTO:
+        method = choose_all_reduce_method(
+            world, x_stacked.nbytes // world, x_stacked.shape[1])
+    return _build_ar(mesh, axis, method, interpret, x_stacked.ndim - 1)(x_stacked)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ar(mesh, axis, method, interpret, nd):
+    """Jit-cached wrapper builder (see allgather._build_ag)."""
+    per_device = oneshot_all_reduce if method is AllReduceMethod.ONE_SHOT \
+        else twoshot_all_reduce
+
+    def f(xs):
+        return per_device(xs[0], axis=axis, interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=P(axis, *([None] * nd)),
+            out_specs=P(*([None] * nd)),
+            check_vma=False,
+        )
+    )

@@ -1,0 +1,107 @@
+"""Shared infrastructure for the Pallas collective/overlap kernels.
+
+Analog of ``python/triton_dist/kernels/nvidia/common_ops.py`` in the reference
+(grid barriers, signal helpers) plus the kernel-call boilerplate the reference
+keeps in each op's ``create_*_context``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+# ---------------------------------------------------------------------------
+# Collective-id registry.
+#
+# Pallas selects the cross-device barrier semaphore by ``collective_id``;
+# concurrently-running kernels (or kernels whose barrier traffic could
+# interleave in one program) must use distinct ids. The reference has the same
+# concern with its symmetric-heap barrier cells, solved by per-op context
+# allocation (e.g. allgather_gemm.py:404). Here ops claim a stable small id by
+# name at import time.
+# ---------------------------------------------------------------------------
+
+# Explicit table (not lazy registration): every process resolves the same
+# name -> id mapping regardless of which kernels it happens to call first.
+# Add new kernel families here.
+_COLLECTIVE_IDS: dict[str, int] = {
+    name: i
+    for i, name in enumerate([
+        "ag_ring",
+        "ag_a2a",
+        "rs_oneshot",
+        "rs_ring",
+        "ar_oneshot",
+        "ar_twoshot",
+        "ag_gemm",
+        "gemm_rs",
+        "ep_a2a_dispatch",
+        "ep_a2a_combine",
+        "ag_group_gemm",
+        "moe_reduce_rs",
+        "sp_ag_attn",
+        "flash_decode_combine",
+    ])
+}
+
+
+def collective_id_for(name: str) -> int:
+    """Stable collective id for a kernel family, from the explicit table above
+    (SPMD requires every device/process agree on the barrier-semaphore id)."""
+    try:
+        return _COLLECTIVE_IDS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown kernel family {name!r}: add it to common._COLLECTIVE_IDS "
+            f"so all processes agree on its collective id"
+        ) from None
+
+
+def compiler_params(collective_id: int) -> pltpu.CompilerParams:
+    return pltpu.CompilerParams(has_side_effects=True, collective_id=collective_id)
+
+
+def local_copy(src_ref, dst_ref, sem):
+    """Synchronous local HBM<->VMEM/HBM copy via the DMA engine."""
+    dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
+    dma.start()
+    dma.wait()
+
+
+# Receiver-side arrival wait; single implementation lives in the language
+# layer (the shmem putmem_signal counterpart).
+from triton_distributed_tpu.language.shmem import wait_dma_arrival as wait_recv  # noqa: E402,F401
+
+
+def dma_sems(n: int):
+    """Scratch spec for an array of ``n`` DMA semaphores."""
+    return pltpu.SemaphoreType.DMA((n,))
+
+
+def make_pallas_call(kernel, *, out_shape, in_specs, out_specs, scratch_shapes,
+                     collective_id, interpret=None, grid=None, grid_spec=None):
+    """Uniform ``pl.pallas_call`` wrapper: ANY-space refs by default,
+    side-effectful, interpret-resolved (compiled on real TPU, interpreted with
+    faithful remote-DMA simulation elsewhere — see runtime/platform.py)."""
+    kwargs = {}
+    if grid is not None:
+        kwargs["grid"] = grid
+    if grid_spec is not None:
+        kwargs["grid_spec"] = grid_spec
+    return pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
+        compiler_params=compiler_params(collective_id),
+        interpret=resolve_interpret(interpret),
+        **kwargs,
+    )
+
+
+def any_spec():
+    return pl.BlockSpec(memory_space=pl.ANY)
