@@ -18,3 +18,14 @@ from triton_distributed_tpu.kernels.allreduce import (  # noqa: F401
     oneshot_all_reduce,
     twoshot_all_reduce,
 )
+from triton_distributed_tpu.kernels.allgather_gemm import (  # noqa: F401
+    AGGEMMConfig,
+    ag_gemm,
+    ag_gemm_device,
+    ag_gemm_single_chip,
+)
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
+    GEMMRSConfig,
+    gemm_rs,
+    gemm_rs_device,
+)

@@ -83,15 +83,9 @@ def _ring_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
     sends = []
     for s in range(world - 1):
         src = jax.lax.rem(me - s + world, world)  # chunk forwarded at step s
-        dma = pltpu.make_async_remote_copy(
-            src_ref=o_ref.at[pl.ds(src * m, m)],
-            dst_ref=o_ref.at[pl.ds(src * m, m)],
-            send_sem=send_sems.at[s],
-            recv_sem=recv_sems.at[s],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            o_ref.at[pl.ds(src * m, m)], o_ref.at[pl.ds(src * m, m)],
+            send_sems.at[s], recv_sems.at[s], axis, right)
         sends.append(dma)
         # Chunk (me-1-s) arrives from the left at step s; it is what we
         # forward at step s+1, so the wait doubles as the send dependency.
@@ -117,15 +111,9 @@ def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
         # Receiver waits slot ``src``; we are src ``me`` on every peer.
-        dma = pltpu.make_async_remote_copy(
-            src_ref=x_ref,
-            dst_ref=o_ref.at[pl.ds(me * m, m)],
-            send_sem=send_sems.at[i],
-            recv_sem=recv_sems.at[me],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            x_ref, o_ref.at[pl.ds(me * m, m)],
+            send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
     common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)

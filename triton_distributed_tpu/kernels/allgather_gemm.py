@@ -1,0 +1,259 @@
+"""AG-GEMM: allgather-overlapped matmul — the flagship TP overlap op.
+
+TPU-native analog of the reference's ``kernels/nvidia/allgather_gemm.py``
+(744 LoC: ``create_ag_gemm_context`` :489, ``ag_gemm`` :534, persistent
+consumer GEMM :146, rank-swizzled tile order via
+``ag_gemm_threadblock_swizzle.py``) and its producer
+``cp_engine_producer_all_gather_intra_node`` (allgather.py:263).
+
+TPU design (SURVEY.md §7 stage 4, hard-part 1):
+- The reference overlaps a copy-engine allgather (comm streams) with a
+  persistent consumer GEMM (compute stream), synchronized by per-segment
+  signal cells. TPUs have no independent comm streams; overlap comes from
+  DMA-compute concurrency *inside one Pallas kernel*: at the first grid step
+  every device pushes its A-shard to all peers (async ICI DMAs); the grid
+  then walks (segment, n-tile) pairs, waiting on each segment's receive
+  semaphore only when first touched, while the MXU computes already-arrived
+  segments. The DMA engines run concurrently with the matmuls — comm is
+  hidden behind compute exactly as in the reference.
+- Rank-swizzled consumer order: segment ``s`` maps to source rank
+  ``(me + s) % world``, so every device computes its *own* segment first
+  (zero wait) and meets remote segments in expected-arrival order — the role
+  of the reference's threadblock swizzle, done with a scalar-prefetched
+  ``me`` in the output BlockSpec index map.
+- Producer variants: ``all2all`` direct pushes (one hop, world-1 concurrent
+  DMAs). A ring-forward producer lands with multi-slice support, mirroring
+  AllGatherMethod.
+
+Sharding convention (column-parallel TP matmul, reference TP_MLP up-proj):
+  A: (M, K) sharded on M over ``axis``  -> per-device (m, K), m = M/world
+  B: (K, N) sharded on N over ``axis``  -> per-device (K, n_local)
+  C: (M, N) sharded on N over ``axis``  -> per-device (M, n_local)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+
+@dataclasses.dataclass(frozen=True)
+class AGGEMMConfig:
+    """Tile configuration (the analog of the reference's per-op context block
+    sizes, allgather_gemm.py:404). ``block_n`` tiles the local N dimension of
+    the consumer matmul; the M dimension is walked per rank segment."""
+
+    block_n: int = 256
+
+    def n_tiles(self, n_local: int) -> int:
+        if n_local % self.block_n:
+            raise ValueError(f"n_local {n_local} not divisible by block_n {self.block_n}")
+        return n_local // self.block_n
+
+
+def _ag_gemm_kernel(me_ref, a_ref, b_ref, o_ref, a_full, a_vmem, send_sems,
+                    recv_sems, copy_sem, *, axis: str, world: int,
+                    n_tiles: int):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    me = me_ref[0]
+    m = a_ref.shape[0]
+    src = jax.lax.rem(me + s, world)
+
+    @pl.when((s == 0) & (j == 0))
+    def _startup():
+        # All devices in the kernel before anyone receives remote pushes.
+        dl.barrier_all(axis)
+        common.local_copy(a_ref, a_full.at[me], copy_sem)
+        for i in range(world - 1):
+            peer = jax.lax.rem(me + 1 + i, world)
+            common.remote_copy(
+                a_ref, a_full.at[me],
+                send_sems.at[i], recv_sems.at[me], axis, peer)
+
+    # First touch of a remote segment: wait for its arrival (the dl.wait +
+    # consume_token of the reference's consumer GEMM, allgather_gemm.py:146).
+    @pl.when((j == 0) & (s > 0))
+    def _arrive():
+        common.wait_recv(a_full.at[src], recv_sems.at[src])
+
+    # Segment into VMEM once per (segment, all n-tiles).
+    @pl.when(j == 0)
+    def _load():
+        common.local_copy(a_full.at[src], a_vmem, copy_sem)
+
+    o_ref[...] = jnp.dot(
+        a_vmem[...], b_ref[...], preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+
+    # Drain sends before kernel exit.
+    @pl.when((s == world - 1) & (j == n_tiles - 1))
+    def _drain():
+        for i in range(world - 1):
+            common.wait_recv(a_ref, send_sems.at[i])
+
+
+def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
+                   config: AGGEMMConfig | None = None, interpret=None):
+    """Per-device AG-GEMM (composable inside shard_map):
+    ``(m, K) x (K, n_local) -> (world*m, n_local)`` with the allgather of A
+    overlapped into the matmul."""
+    config = config or AGGEMMConfig()
+    world = jax.lax.axis_size(axis)
+    m, k = a_local.shape
+    k2, n_local = b_local.shape
+    if k != k2:
+        raise ValueError(f"K mismatch: A has {k}, B has {k2}")
+    if world == 1:
+        return ag_gemm_single_chip(a_local, b_local,
+                                   block_n=min(config.block_n, n_local),
+                                   interpret=interpret)
+    n_tiles = config.n_tiles(n_local)
+    bn = config.block_n
+
+    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, n_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),     # a_local
+            pl.BlockSpec((k, bn), lambda s, j, me_ref: (0, j)),  # b tile
+        ],
+        out_specs=pl.BlockSpec(
+            (m, bn),
+            lambda s, j, me_ref: (jax.lax.rem(me_ref[0] + s, world), j),
+        ),
+        scratch_shapes=[
+            pltpu.HBM((world, m, k), a_local.dtype),  # gathered-A staging
+            pltpu.VMEM((m, k), a_local.dtype),        # current segment
+            common.dma_sems(world - 1),               # send
+            common.dma_sems(world),                   # recv (slot per src)
+            pltpu.SemaphoreType.DMA(()),              # local copies
+        ],
+    )
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+    return pl.pallas_call(
+        functools.partial(_ag_gemm_kernel, axis=axis, world=world,
+                          n_tiles=n_tiles),
+        out_shape=jax.ShapeDtypeStruct((world * m, n_local), out_dtype),
+        grid_spec=grid_spec,
+        compiler_params=common.compiler_params(
+            common.collective_id_for("ag_gemm")),
+        interpret=resolve_interpret(interpret),
+    )(me, a_local, b_local)
+
+
+# ---------------------------------------------------------------------------
+# Single-chip tiled matmul (world == 1 degenerate path; also the bench.py
+# kernel: MXU-tiled, f32 accumulation).
+# ---------------------------------------------------------------------------
+
+
+def _matmul_kernel(a_ref, b_ref, o_ref, acc_ref, *, k_tiles: int):
+    k = pl.program_id(2)
+
+    @pl.when(k == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(
+        a_ref[...], b_ref[...], preferred_element_type=jnp.float32
+    )
+
+    @pl.when(k == k_tiles - 1)
+    def _store():
+        o_ref[...] = acc_ref[...].astype(o_ref.dtype)
+
+
+def _fit_block(dim: int, preferred: int, align: int) -> int:
+    """Largest divisor of ``dim`` that is <= ``preferred`` and a multiple of
+    ``align`` (Mosaic tiling: last block dim must be a multiple of 128 and
+    the second-minor a multiple of 8, unless equal to the full dimension).
+    Falls back to the full dimension when no aligned divisor exists."""
+    if preferred >= dim:
+        return dim
+    for cand in range(preferred, 0, -1):
+        if dim % cand == 0 and cand % align == 0:
+            return cand
+    return dim
+
+
+def ag_gemm_single_chip(a, b, *, block_m: int = 512, block_n: int = 768,
+                        block_k: int = 1280, auto_block: bool = True,
+                        interpret=None):
+    """Blocked Pallas matmul ``(M, K) x (K, N) -> (M, N)`` with fp32
+    accumulation — the world==1 path of ``ag_gemm`` and the bench kernel.
+    ``auto_block`` shrinks blocks to the nearest MXU-aligned divisor."""
+    m, k = a.shape
+    _, n = b.shape
+    bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    if auto_block:
+        bm = _fit_block(m, bm, 8)
+        bn = _fit_block(n, bn, 128)
+        bk = _fit_block(k, bk, 128)
+    if m % bm or n % bn or k % bk:
+        raise ValueError(f"shape ({m},{k})x({k},{n}) not divisible by blocks "
+                         f"({bm},{bn},{bk})")
+    k_tiles = k // bk
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    return pl.pallas_call(
+        functools.partial(_matmul_kernel, k_tiles=k_tiles),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid=(m // bm, n // bn, k_tiles),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=resolve_interpret(interpret),
+    )(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Host-level wrapper
+# ---------------------------------------------------------------------------
+
+
+def ag_gemm(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
+            config: AGGEMMConfig | None = None, interpret=None):
+    """Standalone AG-GEMM over a mesh axis.
+
+    ``a``: global ``(M, K)`` (sharded on M); ``b``: global ``(K, N)``
+    (sharded on N). Returns global ``(M, N)`` (sharded on N): the matmul of
+    the full A against B, with A's allgather overlapped into the matmul.
+    """
+    mesh = mesh or get_default_mesh()
+    config = config or AGGEMMConfig()
+    return _build_ag_gemm(mesh, axis, config, interpret)(a, b)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_ag_gemm(mesh, axis, config, interpret):
+    def f(al, bl):
+        return ag_gemm_device(al, bl, axis=axis, config=config,
+                              interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(axis, None), P(None, axis)),
+            out_specs=P(None, axis),
+            check_vma=False,
+        )
+    )

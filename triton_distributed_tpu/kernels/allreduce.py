@@ -70,15 +70,9 @@ def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
     sends = []
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
-        dma = pltpu.make_async_remote_copy(
-            src_ref=x_ref,
-            dst_ref=staging.at[me],
-            send_sem=send_sems.at[i],
-            recv_sem=recv_sems.at[me],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            x_ref, staging.at[me],
+            send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
     common.local_copy(x_ref, tmp_ref, copy_sem)
@@ -145,12 +139,9 @@ def _twoshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems,
             common.local_copy(staging.at[s - 1], tmp_ref, copy_sem)
             acc += tmp_ref[...].astype(jnp.float32)
         send_buf[...] = acc.astype(send_buf.dtype)
-        dma = pltpu.make_async_remote_copy(
-            src_ref=send_buf, dst_ref=staging.at[s],
-            send_sem=send_sems.at[s], recv_sem=recv_sems.at[s],
-            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            send_buf, staging.at[s],
+            send_sems.at[s], recv_sems.at[s], axis, right)
         dma.wait_send()
 
     common.local_copy(x_ref.at[pl.ds(me * m, m)], tmp_ref, copy_sem)
@@ -166,15 +157,9 @@ def _twoshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems,
     sends = []
     for s in range(world - 1):
         src = jax.lax.rem(me - s + world, world)
-        dma = pltpu.make_async_remote_copy(
-            src_ref=o_ref.at[pl.ds(src * m, m)],
-            dst_ref=o_ref.at[pl.ds(src * m, m)],
-            send_sem=ag_send_sems.at[s],
-            recv_sem=ag_recv_sems.at[s],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            o_ref.at[pl.ds(src * m, m)], o_ref.at[pl.ds(src * m, m)],
+            ag_send_sems.at[s], ag_recv_sems.at[s], axis, right)
         sends.append(dma)
         rsrc = jax.lax.rem(me - 1 - s + world, world)
         common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], ag_recv_sems.at[s])

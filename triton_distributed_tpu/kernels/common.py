@@ -76,6 +76,15 @@ def local_copy(src_ref, dst_ref, sem):
 from triton_distributed_tpu.language.shmem import wait_dma_arrival as wait_recv  # noqa: E402,F401
 
 
+def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):
+    """Start an async ICI put of ``src_ref`` into ``dst_ref`` on the device at
+    rank ``peer`` along mesh ``axis`` (kernel-side argument order; delegates
+    to the language layer's shmem primitive)."""
+    from triton_distributed_tpu.language.shmem import putmem_nbi
+
+    return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, axis=axis)
+
+
 def dma_sems(n: int):
     """Scratch spec for an array of ``n`` DMA semaphores."""
     return pltpu.SemaphoreType.DMA((n,))

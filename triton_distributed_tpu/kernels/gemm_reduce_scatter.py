@@ -1,0 +1,194 @@
+"""GEMM-RS: matmul with the reduce-scatter overlapped into it.
+
+TPU-native analog of the reference's ``kernels/nvidia/gemm_reduce_scatter.py``
+(590 LoC: ``create_gemm_rs_context`` :79, ``gemm_rs`` :576, persistent
+producer GEMM :130 that notifies per-tile barriers, RS consumer on a
+dedicated ``rs_stream``).
+
+TPU design: one Pallas kernel per device; the grid walks destination
+segments in swizzled order ``dst = (me + 1 + s) % world`` — remote segments
+first, own segment last. As soon as a remote segment's partial product is
+complete it is pushed over ICI into the owner's staging slot (async DMA,
+double-buffered), so all world-1 pushes are in flight while the MXU still
+computes later segments; the final grid steps compute the own segment and
+fold in arriving remote partials. Comm rides entirely under compute — the
+reference's producer-GEMM/RS-consumer stream pair collapsed into one kernel.
+
+Sharding convention (row-parallel TP matmul, reference TP_MLP down-proj):
+  A: (M, K) sharded on K over ``axis``  -> per-device (M, k_local)
+  B: (K, N) sharded on K over ``axis``  -> per-device (k_local, N)
+  C: (M, N) sharded on M over ``axis``  -> per-device (m, N), m = M/world
+  C[me] = sum over ranks of their partial A_r @ B_r segment ``me``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+
+@dataclasses.dataclass(frozen=True)
+class GEMMRSConfig:
+    """Tile configuration (analog of ``ReduceScatter2DContext`` block sizes,
+    reduce_scatter.py:45)."""
+
+    block_n: int = 256
+
+    def n_tiles(self, n: int) -> int:
+        if n % self.block_n:
+            raise ValueError(f"N {n} not divisible by block_n {self.block_n}")
+        return n // self.block_n
+
+
+def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_buf,
+                    acc_ref, tmp_ref, send_sems, recv_sems, copy_sem, *,
+                    axis: str, world: int, n_tiles: int, bn: int):
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+    me = me_ref[0]
+    m = o_ref.shape[0]
+    # Remote segments first (their pushes overlap later compute); own last.
+    dst = jax.lax.rem(me + 1 + s, world)
+    parity = jax.lax.rem(s, 2)
+    is_own = s == world - 1
+
+    @pl.when((s == 0) & (j == 0))
+    def _startup():
+        dl.barrier_all(axis)  # staging live everywhere before pushes land
+
+    # Load this destination's A rows once per segment.
+    @pl.when(j == 0)
+    def _load():
+        common.local_copy(a_ref.at[pl.ds(dst * m, m)], a_vmem, copy_sem)
+
+    # Reusing a send_buf parity slot: its push (started at segment s-2) must
+    # have drained.
+    @pl.when((j == 0) & (s >= 2) & ~is_own)
+    def _reclaim():
+        common.wait_recv(send_buf.at[parity], send_sems.at[s - 2])
+
+    partial = jnp.dot(a_vmem[...], b_ref[...],
+                      preferred_element_type=jnp.float32)
+
+    @pl.when(~is_own)
+    def _stage_remote():
+        send_buf[parity, :, pl.dslice(j * bn, bn)] = partial.astype(send_buf.dtype)
+
+    @pl.when(is_own)
+    def _stage_own():
+        acc_ref[:, pl.dslice(j * bn, bn)] = partial
+
+    # Segment complete -> push the partial to its owner (async; overlaps the
+    # next segments' matmuls — the reference's per-tile notify + rs_stream).
+    @pl.when((j == n_tiles - 1) & ~is_own)
+    def _push():
+        common.remote_copy(
+            send_buf.at[parity], staging.at[me],
+            send_sems.at[s], recv_sems.at[me], axis, dst)
+
+    # Final step: fold in the world-1 remote partials for our segment.
+    @pl.when(is_own & (j == n_tiles - 1))
+    def _reduce():
+        for i in range(world - 1):
+            src = jax.lax.rem(me + 1 + i, world)
+            common.wait_recv(staging.at[src], recv_sems.at[src])
+            common.local_copy(staging.at[src], tmp_ref, copy_sem)
+            acc_ref[...] += tmp_ref[...].astype(jnp.float32)
+        tmp_ref[...] = acc_ref[...].astype(tmp_ref.dtype)
+        common.local_copy(tmp_ref, o_ref, copy_sem)
+        # Drain sends not reclaimed by the parity rotation (the last two).
+        for i in range(max(0, world - 3), world - 1):
+            common.wait_recv(send_buf.at[0], send_sems.at[i])
+
+
+def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
+                   config: GEMMRSConfig | None = None, interpret=None):
+    """Per-device GEMM-RS (composable inside shard_map):
+    ``(M, k_local) x (k_local, N) -> (m, N)`` — segment ``me`` of the
+    reduce-scattered full product, comm overlapped into the matmul."""
+    config = config or GEMMRSConfig()
+    world = jax.lax.axis_size(axis)
+    M, k_local = a_local.shape
+    _, n = b_local.shape
+    if world == 1:
+        from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip
+        return ag_gemm_single_chip(a_local, b_local,
+                                   block_n=min(config.block_n, n),
+                                   interpret=interpret)
+    if M % world:
+        raise ValueError(f"M {M} not divisible by world {world}")
+    m = M // world
+    n_tiles = config.n_tiles(n)
+    bn = config.block_n
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+
+    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, n_tiles),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),                    # a_local
+            pl.BlockSpec((k_local, bn), lambda s, j, me_ref: (0, j)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),              # (m, N)
+        scratch_shapes=[
+            pltpu.HBM((world, m, n), out_dtype),    # incoming partials
+            pltpu.VMEM((m, k_local), a_local.dtype),
+            pltpu.VMEM((2, m, n), out_dtype),       # send double-buffer
+            pltpu.VMEM((m, n), jnp.float32),        # own-segment accumulator
+            pltpu.VMEM((m, n), out_dtype),
+            common.dma_sems(world - 1),
+            common.dma_sems(world),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_gemm_rs_kernel, axis=axis, world=world,
+                          n_tiles=n_tiles, bn=bn),
+        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        grid_spec=grid_spec,
+        compiler_params=common.compiler_params(
+            common.collective_id_for("gemm_rs")),
+        interpret=resolve_interpret(interpret),
+    )(me, a_local, b_local)
+
+
+def gemm_rs(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
+            config: GEMMRSConfig | None = None, interpret=None):
+    """Standalone GEMM-RS over a mesh axis.
+
+    ``a``: global ``(M, K)`` sharded on K; ``b``: global ``(K, N)`` sharded
+    on K. Returns global ``(M, N)`` sharded on M = the full product reduced
+    over the K partials, scattered by M segment.
+    """
+    mesh = mesh or get_default_mesh()
+    config = config or GEMMRSConfig()
+    return _build_gemm_rs(mesh, axis, config, interpret)(a, b)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_gemm_rs(mesh, axis, config, interpret):
+    def f(al, bl):
+        return gemm_rs_device(al, bl, axis=axis, config=config,
+                              interpret=interpret)
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(P(None, axis), P(axis, None)),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )
+    )

@@ -53,15 +53,9 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
     sends = []
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
-        dma = pltpu.make_async_remote_copy(
-            src_ref=x_ref.at[pl.ds(peer * m, m)],
-            dst_ref=staging.at[me],
-            send_sem=send_sems.at[i],
-            recv_sem=recv_sems.at[me],
-            device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            x_ref.at[pl.ds(peer * m, m)], staging.at[me],
+            send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
     # Own contribution seeds the accumulator (overlaps with DMA traffic).
@@ -105,15 +99,9 @@ def _ring_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
             common.local_copy(staging.at[s - 1], tmp_ref, copy_sem)
             acc += tmp_ref[...].astype(jnp.float32)
         send_buf[...] = acc.astype(send_buf.dtype)
-        dma = pltpu.make_async_remote_copy(
-            src_ref=send_buf,
-            dst_ref=staging.at[s],
-            send_sem=send_sems.at[s],
-            recv_sem=recv_sems.at[s],
-            device_id=right,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
-        )
-        dma.start()
+        dma = common.remote_copy(
+            send_buf, staging.at[s],
+            send_sems.at[s], recv_sems.at[s], axis, right)
         # send_buf is rewritten next step: wait local drain now. The ring is
         # latency-bound by the recv dependency anyway (pipelining across
         # sub-chunks is the further optimization, as in the reference's

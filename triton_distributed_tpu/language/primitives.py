@@ -57,13 +57,15 @@ def wait(sem_ref, value: int = 1, *, scope: str = "gpu", semantic: str = "acquir
     pltpu.semaphore_wait(sem_ref, value)
 
 
-def notify(sem_ref, peer=None, *, inc: int = 1, sig_op: str = SIGNAL_ADD,
-           comm_scope: str = "intra_node"):
+def notify(sem_ref, peer=None, *, axis: str = "tp", inc: int = 1,
+           sig_op: str = SIGNAL_ADD, comm_scope: str = "intra_node"):
     """Signal a (possibly remote) semaphore (dl.notify, distributed_ops.py:107).
 
-    ``peer=None`` signals the local semaphore. TPU semaphores accumulate, so
-    only SIGNAL_ADD is supported natively; the scope argument is parity-only —
-    ICI reaches every device in the mesh axis.
+    ``peer=None`` signals the local semaphore; otherwise ``peer`` is the
+    target's rank *along ``axis``* (other mesh axes keep this device's
+    coordinates — correct in multi-axis dp×tp×... meshes). TPU semaphores
+    accumulate, so only SIGNAL_ADD is supported natively; the scope argument
+    is parity-only — ICI reaches every device in the mesh axis.
     """
     del comm_scope
     if sig_op != SIGNAL_ADD:
@@ -75,8 +77,8 @@ def notify(sem_ref, peer=None, *, inc: int = 1, sig_op: str = SIGNAL_ADD,
         pltpu.semaphore_signal(sem_ref, inc=inc)
     else:
         pltpu.semaphore_signal(
-            sem_ref, inc=inc, device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            sem_ref, inc=inc, device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
 
 
@@ -101,8 +103,8 @@ def barrier_all(axis: str = "tp"):
     def signal_peer(i, _):
         peer = jax.lax.rem(me + 1 + i, world)
         pltpu.semaphore_signal(
-            barrier_sem, inc=1, device_id=peer,
-            device_id_type=pltpu.DeviceIdType.LOGICAL,
+            barrier_sem, inc=1, device_id={axis: peer},
+            device_id_type=pltpu.DeviceIdType.MESH,
         )
         return _
 

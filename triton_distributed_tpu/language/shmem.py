@@ -38,43 +38,46 @@ def remote_rank(offset: int | object, axis: str = "tp"):
     return jax.lax.rem(me + offset + world, world)
 
 
-def putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem):
+def putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, *, axis: str = "tp"):
     """Non-blocking put: start an async remote copy ``src_ref -> dst_ref`` on
-    device ``peer``; returns the DMA descriptor (wait with ``.wait()`` or
-    ``quiet``). Analog of ``nvshmem_putmem_nbi_block``
+    the device at rank ``peer`` along mesh ``axis`` (other mesh axes keep this
+    device's coordinates); returns the DMA descriptor (wait with ``.wait()``
+    or ``quiet``). Analog of ``nvshmem_putmem_nbi_block``
     (libnvshmem_device.py put family)."""
     dma = pltpu.make_async_remote_copy(
         src_ref=src_ref,
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id=peer,
-        device_id_type=pltpu.DeviceIdType.LOGICAL,
+        device_id={axis: peer},
+        device_id_type=pltpu.DeviceIdType.MESH,
     )
     dma.start()
     return dma
 
 
-def putmem_signal_nbi(src_ref, dst_ref, peer, send_sem, recv_sem):
+def putmem_signal_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, *,
+                      axis: str = "tp"):
     """Put-with-signal: identical to ``putmem_nbi`` — the receive semaphore IS
     the signal (see module docstring). Named separately for parity with
     ``nvshmem_putmem_signal_nbi_block`` so ported kernels keep their shape."""
-    return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem)
+    return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, axis=axis)
 
 
-def putmem_block(src_ref, dst_ref, peer, send_sem, recv_sem):
+def putmem_block(src_ref, dst_ref, peer, send_sem, recv_sem, *,
+                 axis: str = "tp"):
     """Blocking put: start and wait for *local* completion (source reusable).
     The remote side still observes arrival via ``recv_sem``."""
-    dma = putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem)
+    dma = putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, axis=axis)
     dma.wait_send()
     return dma
 
 
-def signal_op(sem_ref, peer=None, *, inc: int = 1):
+def signal_op(sem_ref, peer=None, *, axis: str = "tp", inc: int = 1):
     """Raise a (remote) signal: ``nvshmemx_signal_op`` analog."""
     from triton_distributed_tpu.language.primitives import notify
 
-    notify(sem_ref, peer, inc=inc)
+    notify(sem_ref, peer, axis=axis, inc=inc)
 
 
 def signal_wait_until(sem_ref, value: int):

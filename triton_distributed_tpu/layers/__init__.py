@@ -1,0 +1,4 @@
+"""Model layers over the overlap-kernel library (L7 analog of the
+reference's ``python/triton_dist/layers/``)."""
+
+from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
