@@ -29,3 +29,14 @@ from triton_distributed_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
     gemm_rs,
     gemm_rs_device,
 )
+from triton_distributed_tpu.kernels.ep_all_to_all import (  # noqa: F401
+    AllToAllContext,
+    all_to_all,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels.moe_overlap import (  # noqa: F401
+    ag_group_gemm_device,
+    ag_moe_mlp_device,
+    moe_reduce_rs_device,
+)
+from triton_distributed_tpu.kernels import moe_utils  # noqa: F401

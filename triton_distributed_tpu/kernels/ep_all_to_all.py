@@ -1,0 +1,181 @@
+"""Low-latency EP AllToAll: single-kernel MoE dispatch/combine exchange.
+
+TPU-native analog of the reference's headline kernel
+``kernels/nvidia/low_latency_all_to_all.py`` (262 LoC: ``AllToAllContext``
+:125, ``fast_all_to_all`` :198, the single ``all_to_all_kernel`` :36 that
+putmem's tokens + splits + scales per peer and handshakes with
+``signal_op``/``signal_wait_until``) and of ``ep_a2a.py``'s
+dispatch/combine pair (README.md:100-186 — 137 µs vs DeepEP's 182 µs).
+
+TPU design:
+- The reference preallocates ``MAX_M`` tokens per (src, dst) pair and
+  double-buffers by call parity — i.e. its protocol is already
+  *static-capacity*, which is exactly what XLA's static shapes want. Each
+  device owns a ``(world, capacity, hidden)`` send layout (slot p = tokens
+  bound for rank p) and receives into the same layout (slot p = tokens from
+  rank p).
+- One Pallas kernel per direction, carrying any number of same-capacity
+  payloads (tokens + expert ids + scales ride together, like the reference's
+  data/splits/scale triple); every device pushes its per-peer blocks and
+  count cell with ``putmem``; the DMA receive semaphore *is* the arrival
+  signal (no separate signal_op round, language/shmem.py), so the handshake
+  is one wait per (source, payload).
+- Token counts ride in a tiny int32 array; receivers mask by count.
+  Variable-byte sends (the reference sends only ``splits[p]`` tokens) are a
+  later optimization — chunked DMA by count — behind the same API.
+- Double-buffering by call parity is unnecessary: staging is freshly scoped
+  per pallas_call and XLA program order separates calls.
+
+``fast_all_to_all`` is its own inverse (combine = dispatch of the routed
+tokens back), mirroring ``kernel_combine_token`` (ep_a2a.py:152).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+
+@dataclasses.dataclass(frozen=True)
+class AllToAllContext:
+    """Static exchange geometry (reference ``AllToAllContext``,
+    low_latency_all_to_all.py:125: max_m / hidden / dtypes / world)."""
+
+    capacity: int       # max tokens per (src, dst) pair  (MAX_M per rank)
+    hidden: int
+    axis: str = "ep"
+
+    def __post_init__(self):
+        if self.capacity % 8:
+            raise ValueError(f"capacity {self.capacity} must be a multiple of 8 "
+                             "(TPU sublane tiling)")
+
+
+def _a2a_kernel(*args, axis: str, world: int, n_payloads: int):
+    sends_in = args[:n_payloads]
+    counts_ref = args[n_payloads]
+    recvs_out = args[n_payloads + 1:2 * n_payloads + 1]
+    rcounts_ref = args[2 * n_payloads + 1]
+    pay_sems = args[2 * n_payloads + 2:3 * n_payloads + 2]
+    cnt_sems = args[3 * n_payloads + 2]
+    copy_sem = args[3 * n_payloads + 3]
+
+    me = jax.lax.axis_index(axis)
+
+    dl.barrier_all(axis)
+
+    dmas = []
+    for i in range(world - 1):
+        peer = jax.lax.rem(me + 1 + i, world)
+        # Blocks bound for `peer` land in its slot `me` (sem slot world-1+me
+        # on the receiver = "arrived from me").
+        for p in range(n_payloads):
+            dmas.append(common.remote_copy(
+                sends_in[p].at[peer], recvs_out[p].at[me],
+                pay_sems[p].at[i], pay_sems[p].at[world - 1 + me], axis, peer))
+        dmas.append(common.remote_copy(
+            counts_ref.at[pl.ds(peer, 1)], rcounts_ref.at[pl.ds(me, 1)],
+            cnt_sems.at[i], cnt_sems.at[world - 1 + me], axis, peer))
+
+    # Own slot: local copies (overlap with the DMA traffic).
+    for p in range(n_payloads):
+        common.local_copy(sends_in[p].at[me], recvs_out[p].at[me], copy_sem)
+    common.local_copy(counts_ref.at[pl.ds(me, 1)],
+                      rcounts_ref.at[pl.ds(me, 1)], copy_sem)
+
+    for i in range(world - 1):
+        src = jax.lax.rem(me + 1 + i, world)
+        for p in range(n_payloads):
+            common.wait_recv(recvs_out[p].at[src], pay_sems[p].at[world - 1 + src])
+        common.wait_recv(rcounts_ref.at[pl.ds(src, 1)],
+                         cnt_sems.at[world - 1 + src])
+    for dma in dmas:
+        dma.wait_send()
+
+
+def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
+                    direction: str = "dispatch", interpret=None):
+    """Per-device exchange (composable inside shard_map).
+
+    ``payloads``: one array or a tuple of arrays, each
+    ``(world, capacity, ...)`` — slot p = data for rank p;
+    ``send_counts``: (world,) int32 — valid tokens per slot.
+    ``direction``: "dispatch" or "combine" — selects the barrier-semaphore
+    collective id so the two directions never share barrier traffic.
+    Returns ``(recv_payloads, recv_counts)`` in the same layout, slot p =
+    from rank p. One kernel, no host round-trip (reference README.md:100).
+    """
+    if direction not in ("dispatch", "combine"):
+        raise ValueError(f"direction must be 'dispatch' or 'combine', got {direction!r}")
+    single = not isinstance(payloads, (tuple, list))
+    payloads = (payloads,) if single else tuple(payloads)
+    world = jax.lax.axis_size(ctx.axis)
+    if world == 1:
+        return (payloads[0] if single else payloads), send_counts
+    for pay in payloads:
+        if pay.shape[0] != world or pay.shape[1] != ctx.capacity:
+            raise ValueError(f"payload {pay.shape} != (world={world}, "
+                             f"capacity={ctx.capacity}, ...)")
+    n = len(payloads)
+    result = pl.pallas_call(
+        functools.partial(_a2a_kernel, axis=ctx.axis, world=world,
+                          n_payloads=n),
+        out_shape=(
+            tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in payloads)
+            + (jax.ShapeDtypeStruct((world,), jnp.int32),)
+        ),
+        in_specs=[common.any_spec()] * (n + 1),
+        out_specs=tuple([common.any_spec()] * (n + 1)),
+        scratch_shapes=(
+            [common.dma_sems(2 * world - 1) for _ in range(n)]
+            + [common.dma_sems(2 * world - 1), pltpu.SemaphoreType.DMA(())]
+        ),
+        compiler_params=common.compiler_params(
+            common.collective_id_for(f"ep_a2a_{direction}")),
+        interpret=resolve_interpret(interpret),
+    )(*payloads, send_counts)
+    *out, rcounts = result
+    return (out[0] if single else tuple(out)), rcounts
+
+
+def all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
+               mesh: Mesh | None = None, interpret=None):
+    """Host-level wrapper over stacked global arrays: each payload
+    ``(world, world, cap, ...)`` (device r owns slice [r]); returns routed
+    arrays where out[r][p] = in[p][r]."""
+    mesh = mesh or get_default_mesh()
+    single = not isinstance(payloads, (tuple, list))
+    payloads = (payloads,) if single else tuple(payloads)
+    ndims = tuple(p.ndim for p in payloads)
+    out, counts = _build_a2a(mesh, ctx, ndims, interpret)(
+        payloads, send_counts)
+    return (out[0] if single else out), counts
+
+
+@functools.lru_cache(maxsize=None)
+def _build_a2a(mesh, ctx, payload_ndims, interpret):
+    def f(toks, counts):
+        out, cnts = fast_all_to_all(tuple(t[0] for t in toks), counts[0],
+                                    ctx=ctx, interpret=interpret)
+        return tuple(o[None] for o in out), cnts[None]
+
+    pay_spec = tuple(P(ctx.axis, *([None] * (nd - 1))) for nd in payload_ndims)
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(pay_spec, P(ctx.axis, None)),
+            out_specs=(pay_spec, P(ctx.axis, None)),
+            check_vma=False,
+        )
+    )

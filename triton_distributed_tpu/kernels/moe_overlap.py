@@ -1,0 +1,98 @@
+"""MoE-TP hybrid overlap ops: AG + GroupGEMM and GroupGEMM + topk-reduce-RS.
+
+TPU-native analogs of the reference's ``allgather_group_gemm.py`` (605 LoC:
+``MoEAllGatherGroupGEMMTensorParallelContext`` :198, ``ag_group_gemm`` :398,
+sorted gather index calc :83, block-aligned scheduling via the csrc
+``moe_ag_scatter_align_block_size`` CUDA op) and ``moe_reduce_rs.py``
+(1432 LoC: rowise grouped-GEMM producer :380, topk-reduce + RS consumer
+:486/:564, ``moe_reduce_rs_rowise`` :816).
+
+TPU design: the communication legs are the Pallas overlap kernels from this
+package (ring/all2all allgather, ring reduce-scatter); the expert compute is
+a batched einsum the XLA scheduler fuses and overlaps with its neighbors'
+prologue/epilogue. Where the reference hand-schedules tile arrival order
+(threadblock_swizzle_ag_moe.cu) we rely on the capacity-grid routing from
+``moe_utils`` — static shapes, no alignment kernel needed. Fusing the
+grouped GEMM *into* the AG kernel (per-segment expert compute as shards
+arrive, like allgather_gemm.py) is the follow-up optimization; the API is
+already shaped for it.
+
+Sharding convention (EP within TP, reference test_ag_moe.py):
+  tokens:   (M, d) sharded on M over ``axis``   -> per-device (m, d)
+  topk_ids: (M, k) sharded on M                 -> per-device (m, k)
+  w_up:     (E, d, f) sharded on f (column-parallel per expert)
+  w_down:   (E, f, d) sharded on f (row-parallel per expert)
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.allgather import ring_all_gather
+from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_scatter
+from triton_distributed_tpu.kernels import moe_utils
+
+
+def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
+                         n_experts: int, expert_capacity: int,
+                         axis: str = "tp", interpret=None):
+    """AG of sequence-sharded tokens + per-expert grouped GEMM.
+
+    x_local (m, d), topk_ids_local (m, k), w_up_local (E, d, f_local)
+    -> (grouped (E, expert_capacity, f_local), expert_counts, src_idx):
+    every device computes all experts over the *gathered* tokens against its
+    f-shard of each expert's weight (column-parallel MoE up-projection,
+    reference ``ag_group_gemm`` allgather_group_gemm.py:398).
+    """
+    x_full = ring_all_gather(x_local, axis=axis, interpret=interpret)
+    ids_full = ring_all_gather(topk_ids_local, axis=axis, interpret=interpret)
+    M, k = ids_full.shape
+    flat_ids = ids_full.reshape(M * k)
+    # Group (token, k) pairs by expert (the role of the csrc alignment op).
+    grouped, counts, src_idx = moe_utils.tokens_by_local_expert(
+        jnp.repeat(x_full, k, axis=0)[None],        # (1, M*k, d) capacity grid
+        flat_ids[None],
+        jnp.asarray([M * k], jnp.int32),
+        n_local_experts=n_experts, expert_base=0,
+        expert_capacity=expert_capacity)
+    out = moe_utils.grouped_gemm(grouped, w_up_local)
+    return out, counts, src_idx
+
+
+def moe_reduce_rs_device(expert_out, src_idx, topk_weights_full, w_down_local,
+                         *, n_tokens: int, topk: int, axis: str = "tp",
+                         interpret=None):
+    """Grouped down-projection + topk-weighted reduce + reduce-scatter.
+
+    expert_out (E, cap_e, f_local), src_idx from ``ag_group_gemm_device``,
+    topk_weights_full (M, k) replicated, w_down_local (E, f_local, d)
+    -> (m, d) M-shard of the topk-combined output, summed over the f shards
+    via ring reduce-scatter (reference ``moe_reduce_rs_rowise``,
+    moe_reduce_rs.py:816)."""
+    down = moe_utils.grouped_gemm(expert_out, w_down_local)  # (E, cap_e, d)
+    flat = moe_utils.scatter_back_from_experts(
+        down, src_idx, world=1, capacity=n_tokens * topk)
+    per_pair = flat.reshape(n_tokens * topk, -1)
+    weighted = per_pair * topk_weights_full.reshape(-1, 1).astype(per_pair.dtype)
+    combined = weighted.reshape(n_tokens, topk, -1).sum(axis=1)  # (M, d) partial
+    return ring_reduce_scatter(combined, axis=axis, interpret=interpret)
+
+
+def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
+                      w_down_local, *, n_experts: int, expert_capacity: int,
+                      activation=jax.nn.silu, axis: str = "tp",
+                      interpret=None):
+    """Full MoE-TP MLP: AG -> GroupGEMM(up) -> act -> GroupGEMM(down) ->
+    topk-reduce -> RS (the reference's "AG MoE" tutorial pipeline)."""
+    up, counts, src_idx = ag_group_gemm_device(
+        x_local, topk_ids_local, w_up_local, n_experts=n_experts,
+        expert_capacity=expert_capacity, axis=axis, interpret=interpret)
+    act = activation(up.astype(jnp.float32)).astype(up.dtype)
+    w_full = ring_all_gather(topk_weights_local, axis=axis,
+                             interpret=interpret)
+    m, k = topk_ids_local.shape
+    world = jax.lax.axis_size(axis)
+    return moe_reduce_rs_device(
+        act, src_idx, w_full, w_down_local, n_tokens=world * m, topk=k,
+        axis=axis, interpret=interpret)

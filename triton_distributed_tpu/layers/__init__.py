@@ -2,3 +2,4 @@
 reference's ``python/triton_dist/layers/``)."""
 
 from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
+from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer  # noqa: F401

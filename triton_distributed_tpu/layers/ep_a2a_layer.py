@@ -1,0 +1,93 @@
+"""Expert-parallel AllToAll layer.
+
+TPU-native analog of the reference's ``layers/nvidia/ep_a2a_layer.py``
+(``EPAll2AllLayer`` :40: ``dispatch`` :195 / ``combine`` :240 with token
+preprocess and symmetric-buffer management).
+
+Flow per device (inside shard_map over the ``ep`` axis):
+  dispatch: route (token, k) pairs by destination rank -> capacity-grid
+            send layout -> one-kernel ``fast_all_to_all`` (tokens + expert
+            ids ride together) -> regroup arrivals by local expert for the
+            grouped GEMM.
+  combine:  scatter expert outputs back to the arrival layout -> reverse
+            ``fast_all_to_all`` -> unsort, weight by topk prob, sum k
+            duplicates.
+
+State between the two halves is an explicit pytree (RoutingPlan + inverse
+indices) instead of the reference's layer-held symmetric buffers — jit-safe
+and functionally pure.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.ep_all_to_all import (
+    AllToAllContext,
+    fast_all_to_all,
+)
+from triton_distributed_tpu.kernels import moe_utils
+
+
+@dataclasses.dataclass(frozen=True)
+class EPAll2AllLayer:
+    """Static MoE exchange config (the reference's layer ctor args,
+    ep_a2a_layer.py:40: max_tokens / hidden / topk / experts / group)."""
+
+    n_experts: int
+    topk: int
+    hidden: int
+    capacity: int            # max tokens per (src, dst) rank pair
+    expert_capacity: int     # max tokens per local expert after arrival
+    axis: str = "ep"
+
+    def ctx(self) -> AllToAllContext:
+        return AllToAllContext(capacity=self.capacity, hidden=self.hidden,
+                               axis=self.axis)
+
+    def dispatch(self, x, topk_ids, topk_weights, *, interpret=None):
+        """Per-device. x: (n, hidden); topk_ids/weights: (n, topk).
+        Returns (grouped (E_local, expert_cap, hidden), expert_counts,
+        state) — state threads to ``combine``."""
+        world = jax.lax.axis_size(self.axis)
+        me = jax.lax.axis_index(self.axis)
+        n_local = self.n_experts // world
+
+        plan = moe_utils.route_to_ranks(
+            topk_ids, topk_weights, n_experts=self.n_experts, world=world,
+            capacity=self.capacity)
+        send, ids = moe_utils.scatter_to_capacity(
+            x, plan, world=world, capacity=self.capacity)
+        (recv, recv_ids), rcounts = fast_all_to_all(
+            (send, ids), plan.counts.astype(jnp.int32), ctx=self.ctx(),
+            interpret=interpret)
+        grouped, expert_counts, src_idx = moe_utils.tokens_by_local_expert(
+            recv, recv_ids[:, :, 0], rcounts,
+            n_local_experts=n_local, expert_base=me * n_local,
+            expert_capacity=self.expert_capacity)
+        state = {"plan": plan, "src_idx": src_idx, "rcounts": rcounts,
+                 "n_tokens": x.shape[0]}
+        return grouped, expert_counts, state
+
+    def combine(self, expert_out, state, *, interpret=None):
+        """Per-device. expert_out: (E_local, expert_cap, hidden).
+        Returns (n, hidden): topk-weighted sum per original token."""
+        world = jax.lax.axis_size(self.axis)
+        back = moe_utils.scatter_back_from_experts(
+            expert_out, state["src_idx"], world=world, capacity=self.capacity)
+        ret, _ = fast_all_to_all(back, state["rcounts"], ctx=self.ctx(),
+                                 direction="combine", interpret=interpret)
+        return moe_utils.gather_from_capacity(
+            ret, state["plan"], n_tokens=state["n_tokens"])
+
+    def moe_mlp(self, x, topk_ids, topk_weights, expert_weights, *,
+                interpret=None):
+        """Full EP-MoE forward (dispatch -> per-expert matmul -> combine);
+        expert_weights: (E_local, hidden, hidden)."""
+        grouped, _, state = self.dispatch(x, topk_ids, topk_weights,
+                                          interpret=interpret)
+        out = moe_utils.grouped_gemm(grouped, expert_weights)
+        return self.combine(out, state, interpret=interpret)
