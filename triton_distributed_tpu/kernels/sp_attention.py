@@ -1,0 +1,213 @@
+"""Sequence-parallel attention: AG-overlap prefill + distributed flash decode.
+
+TPU-native analogs of the reference's long-context pair (SURVEY.md §2.5 SP row):
+- ``sp_ag_attention_intra_node.py`` (521 LoC: KV allgather producer :105,
+  fused attn consumer :256, ``fused_sp_ag_attn_intra_node`` :432): Q sharded
+  by sequence, K/V shards allgathered into symmetric buffers while the
+  flash-attention consumer waits per-(batch, rank) barriers and processes KV
+  segments as they arrive.
+- ``flash_decode.py`` (1161 LoC: split-KV decode :130, inter-rank combine
+  :482, ``gqa_fwd_batch_decode`` hosts :763+): decode with sequence-sharded
+  KV cache — local partial (out, LSE) then ``fast_allgather`` of partials and
+  a log-sum-exp merge.
+
+TPU design:
+- Prefill = ONE Pallas kernel per device: at grid start every device pushes
+  its KV shard to all peers (async ICI DMAs); the grid walks (head, segment)
+  with segments innermost in arrival-swizzled order (own shard first), doing
+  streaming-softmax accumulation per arriving segment — the overlap is
+  DMA-vs-MXU inside the kernel, exactly the AG-GEMM structure applied to
+  attention. Causal masking skips segments right of the diagonal (their
+  semaphores are still drained).
+- Decode partials are exchanged with the ring allgather kernel; the local
+  split-KV attention and the LSE merge are jnp (XLA fuses them well at decode
+  shapes); LSE rides as an extra feature column of the gathered partials —
+  the role of the reference's LL-packed (out, lse) buffers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels.allgather import ring_all_gather
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+_NEG_INF = -1e30
+
+
+def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
+                    q_vmem, k_vmem, v_vmem, acc_ref, m_ref, l_ref,
+                    send_sems, recv_sems, copy_sem, *, axis: str, world: int,
+                    causal: bool, scale: float):
+    h = pl.program_id(0)
+    s = pl.program_id(1)
+    me = me_ref[0]
+    src = jax.lax.rem(me + s, world)  # own shard first, then by distance
+
+    @pl.when((h == 0) & (s == 0))
+    def _startup():
+        dl.barrier_all(axis)
+        common.local_copy(k_ref, k_full.at[me], copy_sem)
+        common.local_copy(v_ref, v_full.at[me], copy_sem)
+        for i in range(world - 1):
+            peer = jax.lax.rem(me + 1 + i, world)
+            common.remote_copy(k_ref, k_full.at[me], send_sems.at[2 * i],
+                               recv_sems.at[2 * me], axis, peer)
+            common.remote_copy(v_ref, v_full.at[me], send_sems.at[2 * i + 1],
+                               recv_sems.at[2 * me + 1], axis, peer)
+
+    # First touch of a remote segment (h == 0 pass walks all segments).
+    @pl.when((h == 0) & (s > 0))
+    def _arrive():
+        common.wait_recv(k_full.at[src], recv_sems.at[2 * src])
+        common.wait_recv(v_full.at[src], recv_sems.at[2 * src + 1])
+
+    @pl.when(s == 0)
+    def _init_head():
+        common.local_copy(q_ref.at[h], q_vmem, copy_sem)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Causal: segment right of the diagonal contributes nothing.
+    needed = (src <= me) if causal else (src == src)
+
+    @pl.when(needed)
+    def _segment():
+        common.local_copy(k_full.at[src, h], k_vmem, copy_sem)
+        common.local_copy(v_full.at[src, h], v_vmem, copy_sem)
+        q = q_vmem[...].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k_vmem[...].astype(jnp.float32),
+            (((1,), (1,)), ((), ()))) * scale          # (m, m_kv)
+        if causal:
+            m_q, m_kv = scores.shape
+            rows = me * m_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            cols = src * m_kv + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            scores = jnp.where(rows >= cols, scores, _NEG_INF)
+        seg_max = jnp.max(scores, axis=1, keepdims=True)
+        new_max = jnp.maximum(m_ref[...], seg_max)
+        corr = jnp.exp(m_ref[...] - new_max)
+        p = jnp.exp(scores - new_max)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v_vmem[...].astype(jnp.float32), (((1,), (0,)), ((), ())))
+        m_ref[...] = new_max
+
+    @pl.when(s == world - 1)
+    def _finish_head():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+    @pl.when((h == pl.num_programs(0) - 1) & (s == world - 1))
+    def _drain():
+        for i in range(world - 1):
+            common.wait_recv(k_ref, send_sems.at[2 * i])
+            common.wait_recv(v_ref, send_sems.at[2 * i + 1])
+
+
+def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
+                           causal: bool = True, scale: float | None = None,
+                           interpret=None):
+    """Per-device SP prefill attention (composable inside shard_map).
+
+    q/k/v_local: (H, m, dh) — the sequence dim sharded over ``axis``.
+    Returns (H, m, dh): this device's Q rows attended over the FULL sequence,
+    with the KV allgather overlapped into the attention."""
+    world = jax.lax.axis_size(axis)
+    H, m, dh = q_local.shape
+    scale = dh ** -0.5 if scale is None else scale
+    if world == 1:
+        return _single_device_attn(q_local, k_local, v_local, causal=causal,
+                                   scale=scale)
+    m_kv = k_local.shape[1]
+
+    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(H, world),
+        in_specs=[common.any_spec()] * 3,
+        out_specs=pl.BlockSpec((1, m, dh), lambda h, s, me_ref: (h, 0, 0)),
+        scratch_shapes=[
+            pltpu.HBM((world, H, m_kv, dh), k_local.dtype),
+            pltpu.HBM((world, H, m_kv, dh), v_local.dtype),
+            pltpu.VMEM((m, dh), q_local.dtype),
+            pltpu.VMEM((m_kv, dh), k_local.dtype),
+            pltpu.VMEM((m_kv, dh), v_local.dtype),
+            pltpu.VMEM((m, dh), jnp.float32),    # acc
+            pltpu.VMEM((m, 1), jnp.float32),     # running max
+            pltpu.VMEM((m, 1), jnp.float32),     # denominator
+            common.dma_sems(2 * (world - 1)),
+            common.dma_sems(2 * world),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_sp_attn_kernel, axis=axis, world=world,
+                          causal=causal, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((H, m, dh), q_local.dtype),
+        grid_spec=grid_spec,
+        compiler_params=common.compiler_params(
+            common.collective_id_for("sp_ag_attn")),
+        interpret=resolve_interpret(interpret),
+    )(me, q_local, k_local, v_local)
+
+
+def _single_device_attn(q, k, v, *, causal: bool, scale: float):
+    scores = jnp.einsum("hmd,hnd->hmn", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if causal:
+        m, n = scores.shape[-2:]
+        mask = jnp.arange(m)[:, None] >= jnp.arange(n)[None, :]
+        scores = jnp.where(mask, scores, _NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("hmn,hnd->hmd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Distributed flash decode
+# ---------------------------------------------------------------------------
+
+
+def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
+                        scale: float | None = None, interpret=None):
+    """Per-device distributed decode attention (composable inside shard_map).
+
+    q: (B, H, dh) replicated; k/v_cache_local: (B, H, m_kv, dh) — the KV
+    sequence dim sharded over ``axis``. Each device computes its split-KV
+    partial (out, LSE); partials are ring-allgathered and LSE-merged
+    (reference flash_decode.py:482 inter-rank combine).
+    """
+    world = jax.lax.axis_size(axis)
+    B, H, dh = q.shape
+    scale = dh ** -0.5 if scale is None else scale
+
+    scores = jnp.einsum("bhd,bhnd->bhn", q.astype(jnp.float32),
+                        k_cache_local.astype(jnp.float32)) * scale
+    local_max = jnp.max(scores, axis=-1, keepdims=True)
+    p = jnp.exp(scores - local_max)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    out_local = jnp.einsum("bhn,bhnd->bhd", p, v_cache_local.astype(jnp.float32))
+    out_local = out_local / denom
+    lse_local = (local_max + jnp.log(denom))[..., 0]       # (B, H)
+
+    if world == 1:
+        return out_local.astype(q.dtype)
+
+    # Pack (out, lse) rows; gather all ranks' partials over ICI.
+    packed = jnp.concatenate(
+        [out_local.reshape(B * H, dh), lse_local.reshape(B * H, 1)], axis=-1)
+    gathered = ring_all_gather(packed, axis=axis, interpret=interpret)
+    gathered = gathered.reshape(world, B, H, dh + 1)
+    outs, lses = gathered[..., :dh], gathered[..., dh]     # (w,B,H,dh), (w,B,H)
+
+    # LSE merge: softmax over ranks weights each partial.
+    w = jax.nn.softmax(lses, axis=0)[..., None]
+    return jnp.sum(w * outs, axis=0).astype(q.dtype)

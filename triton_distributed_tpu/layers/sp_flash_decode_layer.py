@@ -1,0 +1,47 @@
+"""Sequence-parallel GQA flash-decode layer.
+
+TPU-native analog of the reference's ``layers/nvidia/sp_flash_decode_layer.py``
+(``SpGQAFlashDecodeAttention`` :44: ``forward`` :83 — local split-KV decode ->
+``fast_allgather`` partials with adaptive symm-buffer sizing :116-130 ->
+inter-rank LSE combine).
+
+The adaptive buffer management disappears on TPU (static shapes; the gather
+staging is scoped per kernel call); GQA is handled by expanding KV heads to
+query heads before the split-KV partial — XLA fuses the broadcast into the
+einsum, so no extra HBM traffic materializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from triton_distributed_tpu.kernels.sp_attention import flash_decode_device
+
+
+@dataclasses.dataclass(frozen=True)
+class SpGQAFlashDecodeAttention:
+    """Static decode-attention config (reference ctor :44: q heads, kv heads,
+    head_dim, kv groups)."""
+
+    num_q_heads: int
+    num_kv_heads: int
+    head_dim: int
+    axis: str = "sp"
+
+    def __post_init__(self):
+        if self.num_q_heads % self.num_kv_heads:
+            raise ValueError(
+                f"q heads {self.num_q_heads} not divisible by kv heads "
+                f"{self.num_kv_heads}")
+
+    def __call__(self, q, k_cache_local, v_cache_local, *, interpret=None):
+        """q: (B, Hq, dh); k/v_cache_local: (B, Hkv, m_kv, dh) with the KV
+        sequence dim sharded over ``axis``. Returns (B, Hq, dh)."""
+        groups = self.num_q_heads // self.num_kv_heads
+        if groups > 1:
+            k_cache_local = jnp.repeat(k_cache_local, groups, axis=1)
+            v_cache_local = jnp.repeat(v_cache_local, groups, axis=1)
+        return flash_decode_device(q, k_cache_local, v_cache_local,
+                                   axis=self.axis, interpret=interpret)
