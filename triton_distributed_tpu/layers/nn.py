@@ -1,0 +1,82 @@
+"""Shared neural-net ops for the model layers.
+
+TPU-native analogs of the reference's host-side helpers in
+``layers/nvidia/tp_attn.py`` (``layer_norm`` :60, ``_set_cos_sin_cache`` :69,
+``apply_rotary_pos_emb`` :159) and its flash-attn-with-kvcache call. Pure
+jnp — everything here is traced under jit and fuses into neighbouring ops;
+the Pallas fast paths (flash decode) live in ``kernels/``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = float("-inf")
+
+
+def rms_norm(x, w, eps: float = 1e-6):
+    """RMSNorm over the last dim, fp32 math, cast back to x.dtype."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_angles(positions, head_dim: int, theta: float):
+    """cos/sin tables for NeoX-style RoPE. positions: (..., L) int ->
+    cos, sin each (..., L, head_dim//2) fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
+                                / head_dim))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def apply_rope(x, cos, sin):
+    """Rotate-half RoPE (HF Qwen/Llama convention: the half-split variant).
+
+    x: (..., L, H, dh); cos/sin: (..., L, dh//2) — broadcast over heads.
+    """
+    dh = x.shape[-1]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : dh // 2], xf[..., dh // 2 :]
+    c, s = cos[..., None, :], sin[..., None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s],
+                           axis=-1).astype(x.dtype)
+
+
+def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float):
+    """GQA attention of new queries against a static-length KV cache.
+
+    The jit-friendly decode/prefill attention (the analog of the reference's
+    ``flash_attn_with_kvcache`` call, tp_attn.py:194): the cache has a static
+    ``max_len``; masking keeps only keys that exist (pos < offset + L) and
+    are causal w.r.t. each query row. Fixed shapes mean one compiled program
+    serves every decode step — the XLA twin of CUDA-Graph replay.
+
+    q:            (B, L, Hq, dh)   new queries (rope'd)
+    k/v_cache:    (B, S, Hkv, dh)  already contain the new keys/values
+    offset:       ()               int32 — cache length BEFORE this call
+    -> (B, L, Hq, dh) in q.dtype
+    """
+    B, L, Hq, dh = q.shape
+    S, Hkv = k_cache.shape[1], k_cache.shape[2]
+    g = Hq // Hkv
+    qf = q.astype(jnp.float32).reshape(B, L, Hkv, g, dh)
+    kf = k_cache.astype(jnp.float32)
+    scores = jnp.einsum("blhgd,bshd->blhgs", qf, kf) * scale
+
+    q_pos = offset + jnp.arange(L)                       # (L,)
+    key_pos = jnp.arange(S)                              # (S,)
+    mask = key_pos[None, :] <= q_pos[:, None]            # causal & in-cache
+    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("blhgs,bshd->blhgd", p, v_cache.astype(jnp.float32))
+    return out.reshape(B, L, Hq, dh).astype(q.dtype)
+
+
+def cache_update(cache, new, offset):
+    """Write ``new`` (B, L, H, dh) into ``cache`` (B, S, H, dh) at ``offset``
+    along the sequence dim. Functional: returns the new cache array."""
+    return jax.lax.dynamic_update_slice(
+        cache, new.astype(cache.dtype), (0, offset, 0, 0))

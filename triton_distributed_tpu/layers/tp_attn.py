@@ -1,0 +1,199 @@
+"""Tensor-parallel attention layer.
+
+TPU-native analog of the reference's ``layers/nvidia/tp_attn.py`` (``TP_Attn``
+:78): QKV projection column-parallel (sharded over the head dim), output
+projection row-parallel, with three forward modes mirroring the reference's
+``torch_fwd`` (:170) / ``dist_triton_fwd`` (:203) / ``dist_triton_AR_fwd``
+(:240):
+
+  ``xla_fwd``  — golden path: all_gather x -> local QKV -> attention ->
+                 psum_scatter (XLA collectives); correctness reference.
+  ``dist_fwd`` — AG-GEMM(x, w_qkv) -> qk-norm/RoPE/cache -> attention ->
+                 GEMM-RS(out, w_o): comm overlapped into both projections;
+                 input and output are batch-sharded.
+  ``ar_fwd``   — replicated x: local GEMMs -> attention -> one-shot
+                 allreduce — the small-batch latency mode.
+
+All ``*_fwd`` are per-device functions composable inside ``shard_map``
+(the Qwen3 model stacks them under one jit). The KV cache holds this
+device's kv-head shard for the FULL batch in every mode, so caches are
+layout-compatible across modes (prefill in one, decode in another —
+reference engine.py:121 prefills in torch mode then decodes dist).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allgather_gemm import (
+    AGGEMMConfig,
+    ag_gemm_device,
+)
+from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+    GEMMRSConfig,
+    gemm_rs_device,
+)
+from triton_distributed_tpu.kernels.allreduce import oneshot_all_reduce
+from triton_distributed_tpu.layers import nn
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class TPAttn:
+    """GQA attention with TP-sharded weights.
+
+    Weight sharding (reference ``_init_parameters``, tp_attn.py:97):
+      w_qkv: (d_model, n_heads*dh + 2*n_kv_heads*dh) fused so each device's
+             column shard is [q_local | k_local | v_local] (``pack_qkv``).
+      w_o:   (n_heads*dh, d_model) sharded on the input (head) dim — heads
+             are contiguous per rank, so plain P(axis, None) works.
+      q_norm/k_norm: (dh,) replicated (Qwen3 per-head RMSNorm).
+    """
+
+    d_model: int
+    n_heads: int
+    n_kv_heads: int
+    head_dim: int
+    axis: str = "tp"
+    dtype: jnp.dtype = jnp.bfloat16
+    rope_theta: float = 1e6
+    qk_norm: bool = True
+    rms_eps: float = 1e-6
+    block_n: int = 256
+
+    def sizes(self, world: int):
+        """(q_size, kv_size) per device."""
+        if self.n_heads % world or self.n_kv_heads % world:
+            raise ValueError(
+                f"heads ({self.n_heads}, {self.n_kv_heads}) not divisible by "
+                f"world {world}")
+        return (self.n_heads // world * self.head_dim,
+                self.n_kv_heads // world * self.head_dim)
+
+    # -- weight packing -----------------------------------------------------
+
+    def pack_qkv(self, wq, wk, wv, world: int):
+        """Fuse (d, Hq*dh), (d, Hkv*dh), (d, Hkv*dh) into the layout whose
+        P(None, axis) shard is [q_local | k_local | v_local] per device."""
+        d = self.d_model
+        qs, kvs = self.sizes(world)
+        q = wq.reshape(d, world, qs)
+        k = wk.reshape(d, world, kvs)
+        v = wv.reshape(d, world, kvs)
+        return jnp.concatenate([q, k, v], axis=2).reshape(
+            d, world * (qs + 2 * kvs))
+
+    def unpack_qkv(self, w_qkv, world: int):
+        """Inverse of ``pack_qkv`` -> (wq, wk, wv)."""
+        d = self.d_model
+        qs, kvs = self.sizes(world)
+        w = w_qkv.reshape(d, world, qs + 2 * kvs)
+        return (w[:, :, :qs].reshape(d, world * qs),
+                w[:, :, qs:qs + kvs].reshape(d, world * kvs),
+                w[:, :, qs + kvs:].reshape(d, world * kvs))
+
+    def init(self, key, mesh: Mesh | None = None):
+        """Sharded random params (models load real weights instead)."""
+        mesh = mesh or get_default_mesh()
+        world = mesh.shape[self.axis]
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        d, dh = self.d_model, self.head_dim
+        scale = d ** -0.5
+        wq = (jax.random.normal(kq, (d, self.n_heads * dh)) * scale).astype(self.dtype)
+        wk = (jax.random.normal(kk, (d, self.n_kv_heads * dh)) * scale).astype(self.dtype)
+        wv = (jax.random.normal(kv, (d, self.n_kv_heads * dh)) * scale).astype(self.dtype)
+        wo = (jax.random.normal(ko, (self.n_heads * dh, d)) * scale).astype(self.dtype)
+        params = {
+            "w_qkv": jax.device_put(self.pack_qkv(wq, wk, wv, world),
+                                    NamedSharding(mesh, P(None, self.axis))),
+            "w_o": jax.device_put(wo, NamedSharding(mesh, P(self.axis, None))),
+        }
+        if self.qk_norm:
+            params["q_norm"] = jnp.ones((dh,), jnp.float32)
+            params["k_norm"] = jnp.ones((dh,), jnp.float32)
+        return params
+
+    def param_specs(self):
+        specs = {"w_qkv": P(None, self.axis), "w_o": P(self.axis, None)}
+        if self.qk_norm:
+            specs["q_norm"] = P()
+            specs["k_norm"] = P()
+        return specs
+
+    # -- shared core --------------------------------------------------------
+
+    def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world):
+        """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
+        output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
+        cache-append -> GQA-attend pipeline shared by every mode
+        (reference tp_attn.py:217-233)."""
+        B, L, _ = qkv.shape
+        qs, kvs = self.sizes(world)
+        dh = self.head_dim
+        q = qkv[..., :qs].reshape(B, L, -1, dh)
+        k = qkv[..., qs:qs + kvs].reshape(B, L, -1, dh)
+        v = qkv[..., qs + kvs:].reshape(B, L, -1, dh)
+        if self.qk_norm:
+            q = nn.rms_norm(q, params["q_norm"], self.rms_eps)
+            k = nn.rms_norm(k, params["k_norm"], self.rms_eps)
+        positions = offset + jnp.arange(L)
+        cos, sin = nn.rope_angles(positions, dh, self.rope_theta)
+        q = nn.apply_rope(q, cos, sin)
+        k = nn.apply_rope(k, cos, sin)
+        k_cache = nn.cache_update(k_cache, k, offset)
+        v_cache = nn.cache_update(v_cache, v, offset)
+        out = nn.attn_with_cache(q, k_cache, v_cache, offset,
+                                 scale=dh ** -0.5)
+        return out.reshape(B, L, qs), k_cache, v_cache
+
+    # -- per-device forwards (inside shard_map) -----------------------------
+
+    def dist_fwd(self, params, x_local, k_cache, v_cache, offset, *,
+                 interpret=None):
+        """x_local: (B_local, L, d) batch-shard -> same layout out.
+        AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203)."""
+        world = jax.lax.axis_size(self.axis)
+        Bl, L, d = x_local.shape
+        qkv = ag_gemm_device(
+            x_local.reshape(Bl * L, d), params["w_qkv"], axis=self.axis,
+            config=AGGEMMConfig(block_n=self.block_n), interpret=interpret)
+        qkv = qkv.reshape(world * Bl, L, -1)
+        out, k_cache, v_cache = self._qkv_to_attn(
+            params, qkv, k_cache, v_cache, offset, world)
+        out = gemm_rs_device(
+            out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
+            config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
+            interpret=interpret)
+        return out.reshape(Bl, L, d), k_cache, v_cache
+
+    def ar_fwd(self, params, x_full, k_cache, v_cache, offset, *,
+               interpret=None):
+        """x_full: (B, L, d) replicated -> replicated out.
+        Local GEMMs -> one-shot allreduce (reference dist_triton_AR_fwd)."""
+        world = jax.lax.axis_size(self.axis)
+        B, L, d = x_full.shape
+        qkv = x_full @ params["w_qkv"]
+        out, k_cache, v_cache = self._qkv_to_attn(
+            params, qkv, k_cache, v_cache, offset, world)
+        partial = out.reshape(B * L, -1) @ params["w_o"]
+        out = oneshot_all_reduce(partial, axis=self.axis, interpret=interpret)
+        return out.reshape(B, L, d), k_cache, v_cache
+
+    def xla_fwd(self, params, x_local, k_cache, v_cache, offset):
+        """Golden/baseline path: same math via jnp + XLA collectives.
+        Batch-sharded in/out like ``dist_fwd``."""
+        world = jax.lax.axis_size(self.axis)
+        Bl, L, d = x_local.shape
+        x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
+        qkv = x_full.reshape(world * Bl * L, d) @ params["w_qkv"]
+        qkv = qkv.reshape(world * Bl, L, -1)
+        out, k_cache, v_cache = self._qkv_to_attn(
+            params, qkv, k_cache, v_cache, offset, world)
+        partial = out.reshape(world * Bl * L, -1) @ params["w_o"]
+        out = jax.lax.psum_scatter(partial, self.axis, scatter_dimension=0,
+                                   tiled=True)
+        return out.reshape(Bl, L, d), k_cache, v_cache

@@ -1,0 +1,8 @@
+"""Models & inference engine (L8 analog of the reference's
+``python/triton_dist/models/``)."""
+
+from triton_distributed_tpu.models.config import ModelConfig  # noqa: F401
+from triton_distributed_tpu.models.kv_cache import KVCache  # noqa: F401
+from triton_distributed_tpu.models.qwen import Qwen3  # noqa: F401
+from triton_distributed_tpu.models.engine import Engine  # noqa: F401
+from triton_distributed_tpu.models.sampling import sample_token  # noqa: F401

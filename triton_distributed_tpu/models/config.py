@@ -1,0 +1,62 @@
+"""Model configuration.
+
+TPU-native analog of the reference's ``models/config.py`` (``ModelConfig``
+:31). The reference resolves architecture hyper-parameters from HuggingFace
+at load time; this framework runs with zero network egress, so the known
+Qwen3 architectures are recorded here as presets (the numbers are the public
+HF ``config.json`` values) and ``from_name`` resolves them. Loading real
+weights goes through ``Qwen3.load_hf`` with a local checkpoint path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    model_name: str = "Qwen/Qwen3-32B"
+    vocab_size: int = 151_936
+    d_model: int = 5120
+    n_layers: int = 64
+    n_heads: int = 64
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    d_ff: int = 25_600
+    rope_theta: float = 1e6
+    rms_eps: float = 1e-6
+    tie_embeddings: bool = False
+    qk_norm: bool = True
+    max_length: int = 4096
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @classmethod
+    def from_name(cls, name: str, **overrides) -> "ModelConfig":
+        key = name.lower().removeprefix("qwen/")
+        if key not in _PRESETS:
+            raise ValueError(
+                f"unknown model {name!r}; known: {sorted(_PRESETS)}")
+        return cls(model_name=name, **{**_PRESETS[key], **overrides})
+
+
+# Public Qwen3 architecture hyper-parameters (HF config.json values).
+_PRESETS: dict[str, dict] = {
+    "qwen3-0.6b": dict(d_model=1024, n_layers=28, n_heads=16, n_kv_heads=8,
+                       head_dim=128, d_ff=3072, tie_embeddings=True),
+    "qwen3-1.7b": dict(d_model=2048, n_layers=28, n_heads=16, n_kv_heads=8,
+                       head_dim=128, d_ff=6144, tie_embeddings=True),
+    "qwen3-4b": dict(d_model=2560, n_layers=36, n_heads=32, n_kv_heads=8,
+                     head_dim=128, d_ff=9728, tie_embeddings=True),
+    "qwen3-8b": dict(d_model=4096, n_layers=36, n_heads=32, n_kv_heads=8,
+                     head_dim=128, d_ff=12_288),
+    "qwen3-14b": dict(d_model=5120, n_layers=40, n_heads=40, n_kv_heads=8,
+                      head_dim=128, d_ff=17_408),
+    "qwen3-32b": dict(d_model=5120, n_layers=64, n_heads=64, n_kv_heads=8,
+                      head_dim=128, d_ff=25_600),
+    # Tiny config for tests / virtual-mesh dryruns (not a real checkpoint).
+    "tiny": dict(vocab_size=128, d_model=64, n_layers=2, n_heads=8,
+                 n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,
+                 max_length=32, dtype=jnp.float32),
+}

@@ -1,0 +1,130 @@
+"""Inference engine.
+
+TPU-native analog of the reference's ``models/engine.py`` (``Engine`` :37):
+prefill + token-by-token decode over a preallocated KV cache, with the
+decode step as ONE compiled program. Where the reference captures a CUDA
+Graph for the decode step (:75) and replays it, here the step is a single
+``jit`` of (shard_map'd model forward + cache append) with fixed shapes and
+donated cache buffers — XLA's executable replay plays the CUDA-Graph role,
+and buffer donation keeps the KV cache update in place.
+
+The reference prefills in torch mode and decodes in triton_dist mode
+(engine.py:121); cache layouts here are mode-compatible the same way, so
+``Engine(prefill_mode=..., decode_mode=...)`` supports any combination of
+``xla`` / ``dist`` / ``ar``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.models.config import ModelConfig
+from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.models.qwen import Qwen3
+from triton_distributed_tpu.models.sampling import sample_token
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+class Engine:
+    def __init__(self, config: ModelConfig, *, mesh: Mesh | None = None,
+                 mode: str = "dist", prefill_mode: str | None = None,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 params=None, key=None, hf_path: str | None = None,
+                 block_n: int = 256, max_length: int | None = None,
+                 interpret=None):
+        self.config = config
+        self.mesh = mesh or get_default_mesh()
+        self.model = Qwen3(config, block_n=block_n)
+        self.temperature = temperature
+        self.top_p = top_p
+        self.max_length = max_length or config.max_length
+        self.decode_mode = mode
+        self.prefill_mode = prefill_mode or mode
+        self.interpret = interpret
+        if params is not None:
+            self.params = params
+        elif hf_path is not None:
+            self.params = self.model.load_hf(hf_path, self.mesh)
+        else:
+            self.params = self.model.init(
+                jax.random.PRNGKey(0) if key is None else key, self.mesh)
+        self._steps: dict[str, object] = {}
+
+    # -- compiled step ------------------------------------------------------
+
+    def _step_fn(self, mode: str):
+        """jit(shard_map(forward)) for one mode; the decode instance of this
+        (L=1 shapes) is the CUDA-Graph-replay analog."""
+        if mode in self._steps:
+            return self._steps[mode]
+        model, mesh = self.model, self.mesh
+        kspec, vspec, _ = KVCache.spec(model.axis)
+        sm = jax.shard_map(
+            functools.partial(model.forward_device, mode=mode,
+                              interpret=self.interpret),
+            mesh=mesh,
+            in_specs=(model.param_specs(), P(), kspec, vspec, P()),
+            out_specs=(P(), kspec, vspec),
+            check_vma=False,
+        )
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def step(params, ids, kv: KVCache):
+            logits, k, v = sm(params, ids, kv.k, kv.v, kv.offset)
+            return logits, KVCache(k=k, v=v,
+                                   offset=kv.offset + ids.shape[1])
+
+        self._steps[mode] = step
+        return step
+
+    # -- public API ---------------------------------------------------------
+
+    def new_cache(self, batch_size: int) -> KVCache:
+        return KVCache.create(self.config, batch_size, mesh=self.mesh,
+                              axis=self.model.axis,
+                              max_length=self.max_length)
+
+    def prefill(self, input_ids, kv: KVCache):
+        """input_ids: (B, L) -> (logits (B, V), kv)."""
+        return self._step_fn(self.prefill_mode)(self.params, input_ids, kv)
+
+    def decode_step(self, token, kv: KVCache):
+        """token: (B,) -> (logits (B, V), kv)."""
+        return self._step_fn(self.decode_mode)(
+            self.params, token[:, None], kv)
+
+    def serve(self, input_ids, gen_len: int, key=None):
+        """Generate ``gen_len`` tokens after the prompt.
+
+        input_ids: (B, L0) int32 -> (B, gen_len) int32 (reference
+        ``Engine.serve``, engine.py:113: prefill -> sample -> decode loop).
+        """
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, L0 = input_ids.shape
+        if gen_len <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        if L0 + gen_len > self.max_length:
+            raise ValueError(
+                f"prompt ({L0}) + gen_len ({gen_len}) exceeds the KV cache "
+                f"max_length ({self.max_length}); dynamic_update_slice would "
+                f"silently clamp and corrupt the cache")
+        if key is None and self.temperature > 0.0:
+            key = jax.random.PRNGKey(0)  # stochastic sampling needs a key
+        kv = self.new_cache(B)
+
+        logits, kv = self.prefill(input_ids, kv)
+        key, sub = (None, None) if key is None else jax.random.split(key)
+        tok = sample_token(logits, sub, temperature=self.temperature,
+                           top_p=self.top_p)
+        out = [tok]
+        for _ in range(gen_len - 1):
+            logits, kv = self.decode_step(tok, kv)
+            key, sub = (None, None) if key is None else jax.random.split(key)
+            tok = sample_token(logits, sub, temperature=self.temperature,
+                               top_p=self.top_p)
+            out.append(tok)
+        return jnp.stack(out, axis=1)

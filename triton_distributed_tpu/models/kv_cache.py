@@ -1,0 +1,60 @@
+"""Preallocated KV cache.
+
+TPU-native analog of the reference's ``models/kv_cache.py`` (``KV_Cache``
+:29): per-layer (batch, max_length, local_kv_heads, head_dim) tensors with a
+monotonic offset. Differences by design:
+
+- Functional pytree (registered dataclass): updates return a new ``KVCache``
+  whose arrays XLA updates in place under jit via buffer donation — the
+  TPU-idiomatic version of the reference's mutable CUDA tensors.
+- Sharded over the TP axis on the kv-head dim (the reference allocates
+  ``kv_heads // world_size`` per rank; here the mesh does it).
+- A single scalar ``offset`` (the reference keeps a per-batch vector but
+  only ever advances it uniformly — engine.py:150 ``inc_offset``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class KVCache:
+    k: jax.Array          # (n_layers, B, max_length, n_kv_heads, head_dim)
+    v: jax.Array          # same
+    offset: jax.Array     # () int32 — tokens already cached
+
+    @classmethod
+    def create(cls, config, batch_size: int, *, mesh: Mesh | None = None,
+               axis: str = "tp", max_length: int | None = None) -> "KVCache":
+        shape = (config.n_layers, batch_size,
+                 max_length or config.max_length,
+                 config.n_kv_heads, config.head_dim)
+        k = jnp.zeros(shape, config.dtype)
+        v = jnp.zeros(shape, config.dtype)
+        if mesh is not None:
+            sh = NamedSharding(mesh, cls.spec(axis)[0])
+            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        return cls(k=k, v=v, offset=jnp.int32(0))
+
+    @staticmethod
+    def spec(axis: str = "tp"):
+        """PartitionSpecs for (k, v, offset) — kv heads sharded over TP."""
+        kv = P(None, None, None, axis, None)
+        return kv, kv, P()
+
+    def clear(self) -> "KVCache":
+        return KVCache(k=self.k, v=self.v, offset=jnp.int32(0))
+
+    @property
+    def max_length(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def batch_size(self) -> int:
+        return self.k.shape[1]

@@ -1,0 +1,30 @@
+"""Token sampling.
+
+Analog of the reference's ``models/utils.py`` ``sample_token`` (:78):
+greedy / temperature / nucleus (top-p). Pure-jnp and jittable; callers pass
+an explicit PRNG key (functional JAX style). Every host samples with the
+same key on replicated logits, so all ranks pick identical tokens — the
+role the reference's shared torch RNG seed plays.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(logits, key=None, *, temperature: float = 0.0,
+                 top_p: float = 1.0):
+    """logits: (B, V) fp32 -> (B,) int32 sampled token ids."""
+    if temperature == 0.0 or key is None:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep tokens until cumulative prob exceeds top_p (always >= 1 token)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits >= cutoff, logits, -jnp.inf)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
