@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""On-chip block-size sweep for ag_gemm_single_chip (and jnp.dot baseline).
+
+Usage: python tools/sweep_matmul.py [M K N]
+
+Timing notes (axon tunnel): per-call dispatch is ~60-100 ms and the FIRST
+call after switching executables can stall for seconds, but steady-state
+per-call times are stable to ~1 ms. So: warm each (program, iters) twice,
+take the median of the best 3 of 7 calls, and compute the per-iteration time
+as the slope between two loop lengths (cancels constant overhead). Slopes
+implying > PEAK_TFLOPS are measurement faults and are retried.
+"""
+
+import functools
+import statistics
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, ".")
+from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip  # noqa: E402
+
+M, K, N = (int(x) for x in sys.argv[1:4]) if len(sys.argv) > 3 else (4096, 5120, 3200)
+SHORT, LONG = 32, 96
+PEAK_TFLOPS = 250.0  # above any plausible bf16 peak for this chip
+
+
+def make_loop(matmul):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def loop(a, b, n):
+        def body(_, acc):
+            bb = b + (acc[0, 0] * 0).astype(b.dtype)
+            return acc + matmul(a, bb).astype(jnp.float32)
+        return jax.lax.fori_loop(0, n, body, jnp.zeros((M, N), jnp.float32))
+    return loop
+
+
+def _timed(loop, a, b, iters):
+    t0 = time.perf_counter()
+    out = loop(a, b, iters)
+    float(out[0, 0])
+    return (time.perf_counter() - t0) * 1e3
+
+
+def _steady(loop, a, b, iters, calls=7):
+    _timed(loop, a, b, iters)
+    _timed(loop, a, b, iters)  # absorb executable-switch stalls
+    ts = sorted(_timed(loop, a, b, iters) for _ in range(calls))
+    return statistics.median(ts[:3])
+
+
+def slope_ms(loop, a, b, flops, tries=3):
+    for _ in range(tries):
+        s = _steady(loop, a, b, SHORT)
+        l = _steady(loop, a, b, LONG)
+        ms = (l - s) / (LONG - SHORT)
+        if ms > 0 and flops / ms / 1e9 <= PEAK_TFLOPS:
+            return ms
+    return ms  # last attempt, even if implausible
+
+
+def main():
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (M, K), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (K, N), jnp.bfloat16)
+    flops = 2 * M * K * N
+
+    def report(name, ms):
+        print(f"{name:32s}: {ms:7.3f} ms  {flops / ms / 1e9:6.1f} TFLOPs",
+              flush=True)
+
+    xla = make_loop(lambda a, b: jnp.dot(
+        a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
+    report("xla jnp.dot", slope_ms(xla, a, b, flops))
+
+    cfgs = [(bm, bn, bk)
+            for bm in (256, 512, 1024)
+            for bn in (512, 640, 1600)
+            for bk in (1280, 2560)
+            if 2 * (bm * bk + bk * bn) * 2 + bm * bn * 4 <= 13 * 2 ** 20]
+    results = []
+    for bm, bn, bk in cfgs:
+        try:
+            loop = make_loop(lambda a, b, bm=bm, bn=bn, bk=bk:
+                             ag_gemm_single_chip(a, b, block_m=bm,
+                                                 block_n=bn, block_k=bk))
+            ms = slope_ms(loop, a, b, flops)
+            results.append((ms, bm, bn, bk))
+            report(f"pallas bm={bm} bn={bn} bk={bk}", ms)
+        except Exception as e:
+            print(f"pallas bm={bm} bn={bn} bk={bk}: FAIL {type(e).__name__}",
+                  flush=True)
+    results.sort()
+    print("\nbest:", results[:3])
+    report("xla recheck", slope_ms(xla, a, b, flops))
+
+
+if __name__ == "__main__":
+    main()

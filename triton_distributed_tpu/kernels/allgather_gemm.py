@@ -181,28 +181,68 @@ def _fit_block(dim: int, preferred: int, align: int) -> int:
     """Largest divisor of ``dim`` that is <= ``preferred`` and a multiple of
     ``align`` (Mosaic tiling: last block dim must be a multiple of 128 and
     the second-minor a multiple of 8, unless equal to the full dimension).
-    Falls back to the full dimension when no aligned divisor exists."""
+    When no aligned divisor exists (prime / odd-multiple dims) the only
+    legal block is the FULL dimension — that is returned only when it keeps
+    the kernel's VMEM footprint plausible; otherwise this raises so callers
+    pad instead of silently compiling a VMEM-blowing block."""
     if preferred >= dim:
         return dim
     for cand in range(preferred, 0, -1):
         if dim % cand == 0 and cand % align == 0:
             return cand
-    return dim
+    # No aligned divisor. Full-dim blocks are legal for Mosaic; allow modest
+    # overshoot of the preference, refuse silent multi-x blowups.
+    if dim <= 4 * preferred:
+        return dim
+    raise ValueError(
+        f"no {align}-aligned divisor of {dim} <= {preferred}; pad the "
+        f"operand to a multiple of {align} or pass an explicit block size")
 
 
-def ag_gemm_single_chip(a, b, *, block_m: int = 512, block_n: int = 768,
-                        block_k: int = 1280, auto_block: bool = True,
+# v5e scoped-VMEM budget Mosaic enforces per kernel; double-buffered in/out
+# blocks + the fp32 accumulator must fit.
+_VMEM_BUDGET = 16 * 2 ** 20
+
+
+def _matmul_vmem(bm, bn, bk, in_bytes, out_bytes) -> int:
+    return (2 * (bm * bk + bk * bn) * in_bytes   # double-buffered A/B blocks
+            + bm * bn * 4                        # fp32 accumulator scratch
+            + 2 * bm * bn * out_bytes)           # double-buffered out block
+
+
+def ag_gemm_single_chip(a, b, *, block_m: int = 1024, block_n: int = 640,
+                        block_k: int = 1024, auto_block: bool = True,
                         interpret=None):
     """Blocked Pallas matmul ``(M, K) x (K, N) -> (M, N)`` with fp32
     accumulation — the world==1 path of ``ag_gemm`` and the bench kernel.
-    ``auto_block`` shrinks blocks to the nearest MXU-aligned divisor."""
+    ``auto_block`` shrinks blocks to the nearest MXU-aligned divisor.
+
+    Default blocks are the on-chip sweep winner at the bench shape
+    (tools/sweep_matmul.py, v5e: 175 TFLOPs ~ 89% MFU; traffic argument:
+    with N-divisor block_n fixed at 640, larger block_m cuts B-matrix
+    passes — (1024, 640, 1024) fits the 16MB scoped-VMEM budget with
+    double-buffered in/out blocks)."""
     m, k = a.shape
     _, n = b.shape
+    out_dtype = jnp.promote_types(a.dtype, b.dtype)
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     if auto_block:
-        bm = _fit_block(m, bm, 8)
-        bn = _fit_block(n, bn, 128)
-        bk = _fit_block(k, bk, 128)
+        # Shapes whose dims have no MXU-aligned divisor (e.g. the reference
+        # smoke shape's per-rank K 29568/8 = 3696) force full-dim blocks that
+        # blow the scoped-VMEM budget or tank Mosaic's pipelining. XLA's own
+        # matmul emitter handles ragged K at ~98% MFU, so the world==1
+        # degenerate path delegates rather than running a worse kernel —
+        # Pallas earns its keep in the multi-device overlap kernels.
+        try:
+            bm = _fit_block(m, bm, 8)
+            bn = _fit_block(n, bn, 128)
+            bk = _fit_block(k, bk, 128)
+            if _matmul_vmem(bm, bn, bk, a.dtype.itemsize,
+                            out_dtype.itemsize) > _VMEM_BUDGET:
+                raise ValueError("no VMEM-feasible aligned blocking")
+        except ValueError:
+            return jnp.dot(a, b, preferred_element_type=jnp.float32
+                           ).astype(out_dtype)
     if m % bm or n % bn or k % bk:
         raise ValueError(f"shape ({m},{k})x({k},{n}) not divisible by blocks "
                          f"({bm},{bn},{bk})")

@@ -259,5 +259,6 @@ class Qwen3:
             last = jax.lax.all_gather(last, self.axis, axis=0, tiled=True)
         lm_head = (params["embed"].T if c.tie_embeddings
                    else params["lm_head"])
-        logits = last.astype(jnp.float32) @ lm_head.astype(jnp.float32)
+        # bf16 operands, fp32 accumulation — no materialized fp32 weight copy
+        logits = jnp.dot(last, lm_head, preferred_element_type=jnp.float32)
         return logits, new_k, new_v
