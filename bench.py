@@ -63,15 +63,20 @@ def _steady(loop, a, b, iters, calls=7):
     return statistics.median(ts[:3])
 
 
-def _slope_ms(loop, a, b, flops, tries=4):
-    ms = 1e-6
+def _slope_ms(loop, a, b, flops, tries=5, want=2):
+    """Min of ``want`` plausible slope attempts: the floor over measurement
+    windows is the least-contended estimate, and impossibly-fast slopes
+    (> PEAK_TFLOPS, a measurement fault) are rejected."""
+    plausible, ms = [], 1e-6
     for _ in range(tries):
         s = _steady(loop, a, b, SHORT)
         l = _steady(loop, a, b, LONG)
         ms = max((l - s) / (LONG - SHORT), 1e-6)
         if flops / ms / 1e9 <= PEAK_TFLOPS:
-            return ms
-    return ms  # last attempt, clamped positive even if implausible
+            plausible.append(ms)
+            if len(plausible) >= want:
+                return min(plausible)
+    return min(plausible) if plausible else ms
 
 
 def _bench_matmul(fn, m, k, n, seed=0):
@@ -91,7 +96,8 @@ def main():
         lambda a, b: jnp.dot(a, b, preferred_element_type=jnp.float32
                              ).astype(jnp.bfloat16), 4096, 5120, 3200)
     # GEMM-RS smoke shape (docs/build.md:96, per-rank K = 29568/8 = 3696 —
-    # ragged K: auto_block delegates to the XLA emitter, by design).
+    # ragged K: ag_gemm_single_chip delegates to the XLA emitter by design;
+    # the metric key says so).
     rs_ms = _bench_matmul(ag_gemm_single_chip, 8192, 3696, 8192, seed=2)
 
     # TP-MLP block (AG-GEMM -> GLU -> GEMM-RS, world=1 path) at M=4096.
@@ -117,7 +123,7 @@ def main():
         "extras": {
             "xla_dot_same_shape_ms": round(xla_ms, 4),
             "pallas_over_xla": round(ag_ms / xla_ms, 4),
-            "gemm_rs_8192x8192x29568_tp8_ms": round(rs_ms, 4),
+            "gemm_rs_smoke_shape_ms_xla_delegated": round(rs_ms, 4),
             "mlp_block_m4096_ms": round(mlp_ms, 4),
             "mlp_vs_h800_baseline": round(BASE_MLP_MS / mlp_ms, 4),
         },

@@ -22,7 +22,12 @@ import jax.numpy as jnp
 sys.path.insert(0, ".")
 from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip  # noqa: E402
 
-M, K, N = (int(x) for x in sys.argv[1:4]) if len(sys.argv) > 3 else (4096, 5120, 3200)
+if len(sys.argv) == 1:
+    M, K, N = 4096, 5120, 3200
+elif len(sys.argv) == 4:
+    M, K, N = (int(x) for x in sys.argv[1:4])
+else:
+    sys.exit("usage: sweep_matmul.py [M K N]  (all three or none)")
 SHORT, LONG = 32, 96
 PEAK_TFLOPS = 250.0  # above any plausible bf16 peak for this chip
 
@@ -52,13 +57,14 @@ def _steady(loop, a, b, iters, calls=7):
 
 
 def slope_ms(loop, a, b, flops, tries=3):
+    ms = 1e-6
     for _ in range(tries):
         s = _steady(loop, a, b, SHORT)
         l = _steady(loop, a, b, LONG)
-        ms = (l - s) / (LONG - SHORT)
-        if ms > 0 and flops / ms / 1e9 <= PEAK_TFLOPS:
+        ms = max((l - s) / (LONG - SHORT), 1e-6)
+        if flops / ms / 1e9 <= PEAK_TFLOPS:
             return ms
-    return ms  # last attempt, even if implausible
+    return ms  # last attempt, clamped positive even if implausible
 
 
 def main():
@@ -75,11 +81,13 @@ def main():
         a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
     report("xla jnp.dot", slope_ms(xla, a, b, flops))
 
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        _matmul_vmem, _VMEM_BUDGET)
     cfgs = [(bm, bn, bk)
             for bm in (256, 512, 1024)
             for bn in (512, 640, 1600)
             for bk in (1280, 2560)
-            if 2 * (bm * bk + bk * bn) * 2 + bm * bn * 4 <= 13 * 2 ** 20]
+            if _matmul_vmem(bm, bn, bk, 2, 2) <= _VMEM_BUDGET]
     results = []
     for bm, bn, bk in cfgs:
         try:

@@ -210,44 +210,53 @@ def _matmul_vmem(bm, bn, bk, in_bytes, out_bytes) -> int:
             + 2 * bm * bn * out_bytes)           # double-buffered out block
 
 
-def ag_gemm_single_chip(a, b, *, block_m: int = 1024, block_n: int = 640,
-                        block_k: int = 1024, auto_block: bool = True,
+def ag_gemm_single_chip(a, b, *, block_m: int | None = None,
+                        block_n: int | None = None,
+                        block_k: int | None = None, auto_block: bool = True,
                         interpret=None):
     """Blocked Pallas matmul ``(M, K) x (K, N) -> (M, N)`` with fp32
     accumulation — the world==1 path of ``ag_gemm`` and the bench kernel.
     ``auto_block`` shrinks blocks to the nearest MXU-aligned divisor.
 
-    Default blocks are the on-chip sweep winner at the bench shape
-    (tools/sweep_matmul.py, v5e: 175 TFLOPs ~ 89% MFU; traffic argument:
-    with N-divisor block_n fixed at 640, larger block_m cuts B-matrix
-    passes — (1024, 640, 1024) fits the 16MB scoped-VMEM budget with
-    double-buffered in/out blocks)."""
+    Default blocks (all three omitted) are the on-chip sweep winner at the
+    bench shape (tools/sweep_matmul.py, v5e: 175 TFLOPs ~ 89% MFU; traffic
+    argument: with N-divisor block_n fixed at 640, larger block_m cuts
+    B-matrix passes — (1024, 640, 1024) fits the 16MB scoped-VMEM budget
+    with double-buffered in/out blocks).
+
+    With all-default blocks, shapes with no MXU-aligned divisor (e.g. the
+    reference smoke shape's per-rank K 29568/8 = 3696) or no VMEM-feasible
+    blocking DELEGATE to XLA's matmul emitter (~98% MFU on ragged K) — the
+    world==1 path is a degenerate fallback and Pallas earns its keep in the
+    multi-device overlap kernels. Explicitly-passed blocks are never
+    second-guessed: infeasible explicit blocks raise."""
     m, k = a.shape
     _, n = b.shape
     out_dtype = jnp.promote_types(a.dtype, b.dtype)
+    explicit = not (block_m is None and block_n is None and block_k is None)
+    block_m = 1024 if block_m is None else block_m
+    block_n = 640 if block_n is None else block_n
+    block_k = 1024 if block_k is None else block_k
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
     if auto_block:
-        # Shapes whose dims have no MXU-aligned divisor (e.g. the reference
-        # smoke shape's per-rank K 29568/8 = 3696) force full-dim blocks that
-        # blow the scoped-VMEM budget or tank Mosaic's pipelining. XLA's own
-        # matmul emitter handles ragged K at ~98% MFU, so the world==1
-        # degenerate path delegates rather than running a worse kernel —
-        # Pallas earns its keep in the multi-device overlap kernels.
         try:
             bm = _fit_block(m, bm, 8)
             bn = _fit_block(n, bn, 128)
             bk = _fit_block(k, bk, 128)
             if _matmul_vmem(bm, bn, bk, a.dtype.itemsize,
                             out_dtype.itemsize) > _VMEM_BUDGET:
-                raise ValueError("no VMEM-feasible aligned blocking")
+                raise ValueError(
+                    f"blocks ({bm},{bn},{bk}) exceed the {_VMEM_BUDGET >> 20}"
+                    f"MB scoped-VMEM budget")
         except ValueError:
+            if explicit:
+                raise
             return jnp.dot(a, b, preferred_element_type=jnp.float32
                            ).astype(out_dtype)
     if m % bm or n % bn or k % bk:
         raise ValueError(f"shape ({m},{k})x({k},{n}) not divisible by blocks "
                          f"({bm},{bn},{bk})")
     k_tiles = k // bk
-    out_dtype = jnp.promote_types(a.dtype, b.dtype)
     return pl.pallas_call(
         functools.partial(_matmul_kernel, k_tiles=k_tiles),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
