@@ -62,6 +62,28 @@ def test_all_reduce_bf16(mesh8, rng, method):
     assert_allclose(out, expected, atol=0.25, rtol=0.05)
 
 
+def test_one_shot_all_reduce_bitwise_identical_across_ranks(mesh8, rng):
+    """The replicated output must be the SAME BITS on every rank: the kernel
+    reduces in a fixed global rank order (ADVICE r1 — rank-relative order
+    diverged in low precision). bf16 is the order-sensitive probe."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from triton_distributed_tpu.kernels.allreduce import oneshot_all_reduce
+
+    x = _stacked(rng, (WORLD, 8, 64), jnp.bfloat16)
+
+    def f(xs):
+        return oneshot_all_reduce(xs[0], axis="tp")[None]
+
+    out = jax.jit(jax.shard_map(
+        f, mesh=mesh8, in_specs=P("tp", None, None),
+        out_specs=P("tp", None, None), check_vma=False))(x)
+    ranks = np.asarray(out, dtype=np.float32)
+    for r in range(1, WORLD):
+        np.testing.assert_array_equal(ranks[r], ranks[0])
+
+
 def test_all_gather_auto_dispatch(mesh8, rng):
     x = _stacked(rng, (WORLD, 2, 128))
     out = all_gather(x, mesh=mesh8, method="auto")

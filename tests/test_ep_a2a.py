@@ -72,16 +72,37 @@ def test_tokens_by_local_expert_groups_and_inverts(rng):
     toks = jnp.asarray(rng.standard_normal((world, cap, h), dtype=np.float32))
     ids = jnp.asarray(rng.integers(4, 4 + n_local, (world, cap)), jnp.int32)
     counts = jnp.asarray([3, 0, 8, 5], jnp.int32)
-    grouped, gcounts, src_idx = moe_utils.tokens_by_local_expert(
+    grouped, gcounts, src_idx, n_dropped = moe_utils.tokens_by_local_expert(
         toks, ids, counts, n_local_experts=n_local, expert_base=4,
         expert_capacity=16)
     assert int(gcounts.sum()) == int(counts.sum())
+    assert int(n_dropped) == 0
     back = moe_utils.scatter_back_from_experts(grouped, src_idx, world=world,
                                                capacity=cap)
     flat_valid = (np.arange(world * cap) % cap) < np.repeat(np.asarray(counts), cap)
     np.testing.assert_allclose(
         np.asarray(back).reshape(-1, h)[flat_valid],
         np.asarray(toks).reshape(-1, h)[flat_valid], rtol=1e-6)
+
+
+def test_capacity_overflow_surfaces_drop_counts(rng):
+    """Overflow is dropped but NOT silent (ADVICE r1): both routing stages
+    report how many (token, k) pairs were lost."""
+    n, k, n_experts, world = 32, 2, 8, 4
+    ids = jnp.zeros((n, k), jnp.int32)  # everything routes to rank 0
+    w = jnp.ones((n, k), jnp.float32)
+    plan = moe_utils.route_to_ranks(ids, w, n_experts=n_experts, world=world,
+                                    capacity=16)
+    assert int(plan.n_dropped) == n * k - 16
+
+    toks = jnp.ones((world, 8, 4), jnp.float32)
+    eids = jnp.full((world, 8), 4, jnp.int32)  # all to local expert 0
+    counts = jnp.full((world,), 8, jnp.int32)
+    _, gcounts, _, n_dropped = moe_utils.tokens_by_local_expert(
+        toks, eids, counts, n_local_experts=2, expert_base=4,
+        expert_capacity=8)
+    assert int(n_dropped) == world * 8 - 8
+    assert int(gcounts[0]) == 8
 
 
 def test_ep_moe_layer_vs_golden(mesh8, rng):

@@ -116,9 +116,11 @@ def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
     if k != k2:
         raise ValueError(f"K mismatch: A has {k}, B has {k2}")
     if world == 1:
-        return ag_gemm_single_chip(a_local, b_local,
-                                   block_n=min(config.block_n, n_local),
-                                   interpret=interpret)
+        # Degenerate path: single-chip matmul with the sweep-tuned defaults.
+        # config.block_n tiles the multi-device consumer only — passing it
+        # here would count as an explicit block and forfeit the automatic
+        # XLA delegation on ragged/VMEM-infeasible shapes.
+        return ag_gemm_single_chip(a_local, b_local, interpret=interpret)
     n_tiles = config.n_tiles(n_local)
     bn = config.block_n
 

@@ -62,8 +62,10 @@ def choose_all_reduce_method(world: int, nbytes: int, leading_dim: int) -> AllRe
 
 
 def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
-                       acc_ref, tmp_ref, out_vmem, *, axis: str, world: int):
+                       acc_ref, tmp_ref, out_vmem, *, axis: str, world: int,
+                       br: int):
     me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0]
 
     dl.barrier_all(axis)
 
@@ -75,17 +77,31 @@ def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
-    common.local_copy(x_ref, tmp_ref, copy_sem)
-    acc_ref[...] = tmp_ref[...].astype(jnp.float32)
+    # Own contribution into its FIXED staging slot so every rank reduces in
+    # the same global order 0..world-1 — the replicated output is bitwise
+    # identical across ranks (ADVICE r1: rank-relative order diverged).
+    common.local_copy(x_ref, staging.at[me], copy_sem)
+    for src in range(world):
+        @pl.when(src != me)
+        def _wait(src=src):
+            common.wait_recv(staging.at[src], recv_sems.at[src])
 
-    for i in range(world - 1):
-        src = jax.lax.rem(me + 1 + i, world)
-        common.wait_recv(staging.at[src], recv_sems.at[src])
-        common.local_copy(staging.at[src], tmp_ref, copy_sem)
-        acc_ref[...] += tmp_ref[...].astype(jnp.float32)
-
-    out_vmem[...] = acc_ref[...].astype(out_vmem.dtype)
-    common.local_copy(out_vmem, o_ref, copy_sem)
+    # Row-tiled accumulate: VMEM holds (br, ...) tiles, not the full shape
+    # (ADVICE r1: 3 full-shape VMEM buffers blew the budget at target shapes).
+    for t in range(pl.cdiv(m, br)):
+        rows = min(br, m - t * br)
+        rs = pl.ds(t * br, rows)
+        acc = acc_ref.at[pl.ds(0, rows)]
+        tmp = tmp_ref.at[pl.ds(0, rows)]
+        out = out_vmem.at[pl.ds(0, rows)]
+        for src in range(world):
+            common.local_copy(staging.at[src, rs], tmp, copy_sem)
+            if src == 0:
+                acc[...] = tmp[...].astype(jnp.float32)
+            else:
+                acc[...] += tmp[...].astype(jnp.float32)
+        out[...] = acc[...].astype(out_vmem.dtype)
+        common.local_copy(out, o_ref.at[rs], copy_sem)
     for dma in sends:
         dma.wait_send()
 
@@ -96,8 +112,10 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     if world == 1:
         return x_local
     shape = x_local.shape
+    rest = shape[1:]
+    br = common.stage_row_tile(shape[0], rest, x_local.dtype.itemsize)
     return common.make_pallas_call(
-        functools.partial(_oneshot_ar_kernel, axis=axis, world=world),
+        functools.partial(_oneshot_ar_kernel, axis=axis, world=world, br=br),
         out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
         in_specs=[common.any_spec()],
         out_specs=common.any_spec(),
@@ -106,9 +124,9 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
             common.dma_sems(world),
             common.dma_sems(world),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.VMEM(shape, jnp.float32),
-            pltpu.VMEM(shape, x_local.dtype),
-            pltpu.VMEM(shape, x_local.dtype),
+            pltpu.VMEM((br, *rest), jnp.float32),
+            pltpu.VMEM((br, *rest), x_local.dtype),
+            pltpu.VMEM((br, *rest), x_local.dtype),
         ],
         collective_id=common.collective_id_for("ar_oneshot"),
         interpret=interpret,
@@ -120,38 +138,35 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
 # ---------------------------------------------------------------------------
 
 
-def _twoshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems,
-                       ag_send_sems, ag_recv_sems, copy_sem, tmp_ref, send_buf,
-                       *, axis: str, world: int):
+def _twoshot_ar_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
+                       ag_send_sems, ag_recv_sems, copy_sem, acc_ref, tmp_ref,
+                       out_vmem, *, axis: str, world: int, br: int):
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0] // world
     right = jax.lax.rem(me + 1, world)
 
     dl.barrier_all(axis)
 
+    def reduce_chunk(x_off, stage_idx, dst_ref, dst_off):
+        common.reduce_rows_tiled(
+            x_ref, x_off, staging, stage_idx, dst_ref, dst_off, m=m, br=br,
+            acc_ref=acc_ref, tmp_ref=tmp_ref, out_ref=out_vmem,
+            copy_sem=copy_sem)
+
     # --- reduce-scatter leg (ring; see reduce_scatter._ring_rs_kernel) ---
     for s in range(world - 1):
         c = jax.lax.rem(me - s - 1 + world, world)
-        common.local_copy(x_ref.at[pl.ds(c * m, m)], tmp_ref, copy_sem)
-        acc = tmp_ref[...].astype(jnp.float32)
         if s > 0:
             common.wait_recv(staging.at[s - 1], recv_sems.at[s - 1])
-            common.local_copy(staging.at[s - 1], tmp_ref, copy_sem)
-            acc += tmp_ref[...].astype(jnp.float32)
-        send_buf[...] = acc.astype(send_buf.dtype)
+        reduce_chunk(c * m, s - 1 if s > 0 else None, send_hbm, 0)
         dma = common.remote_copy(
-            send_buf, staging.at[s],
+            send_hbm, staging.at[s],
             send_sems.at[s], recv_sems.at[s], axis, right)
         dma.wait_send()
 
-    common.local_copy(x_ref.at[pl.ds(me * m, m)], tmp_ref, copy_sem)
-    acc = tmp_ref[...].astype(jnp.float32)
     common.wait_recv(staging.at[world - 2], recv_sems.at[world - 2])
-    common.local_copy(staging.at[world - 2], tmp_ref, copy_sem)
-    acc += tmp_ref[...].astype(jnp.float32)
-    send_buf[...] = acc.astype(send_buf.dtype)
     # Own fully-reduced segment into place.
-    common.local_copy(send_buf, o_ref.at[pl.ds(me * m, m)], copy_sem)
+    reduce_chunk(me * m, world - 2, o_ref, me * m)
 
     # --- allgather leg (ring; see allgather._ring_ag_kernel) ---
     sends = []
@@ -180,20 +195,23 @@ def twoshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     shape = x_local.shape
     m = shape[0] // world
     rest = shape[1:]
+    br = common.stage_row_tile(m, rest, x_local.dtype.itemsize)
     return common.make_pallas_call(
-        functools.partial(_twoshot_ar_kernel, axis=axis, world=world),
+        functools.partial(_twoshot_ar_kernel, axis=axis, world=world, br=br),
         out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
         in_specs=[common.any_spec()],
         out_specs=common.any_spec(),
         scratch_shapes=[
             pltpu.HBM((world - 1, m, *rest), x_local.dtype),
+            pltpu.HBM((m, *rest), x_local.dtype),   # ring send staging
             common.dma_sems(world - 1),
             common.dma_sems(world - 1),
             common.dma_sems(world - 1),
             common.dma_sems(world - 1),
             pltpu.SemaphoreType.DMA(()),
-            pltpu.VMEM((m, *rest), x_local.dtype),
-            pltpu.VMEM((m, *rest), x_local.dtype),
+            pltpu.VMEM((br, *rest), jnp.float32),
+            pltpu.VMEM((br, *rest), x_local.dtype),
+            pltpu.VMEM((br, *rest), x_local.dtype),
         ],
         collective_id=common.collective_id_for("ar_twoshot"),
         interpret=interpret,

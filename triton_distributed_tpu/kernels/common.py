@@ -8,6 +8,7 @@ keeps in each op's ``create_*_context``.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -88,6 +89,53 @@ def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):
 def dma_sems(n: int):
     """Scratch spec for an array of ``n`` DMA semaphores."""
     return pltpu.SemaphoreType.DMA((n,))
+
+
+# Per-kernel VMEM working-set target for collective staging buffers. Mosaic's
+# scoped-VMEM budget is ~16MB/core; collectives keep their row-tile buffers
+# well under half of it so the compiler has room for pipelining (ADVICE r1:
+# full-shape VMEM staging blew the budget at target shapes).
+VMEM_STAGE_BUDGET = 4 * 2 ** 20
+
+
+def row_tile(m: int, row_bytes: int, budget: int = VMEM_STAGE_BUDGET) -> int:
+    """Row-tile size so a kernel's VMEM row buffers (``row_bytes`` combined
+    bytes per row across all tile buffers) stay under ``budget``; 8-aligned
+    (sublane) when tiling at all."""
+    br = max(1, budget // max(row_bytes, 1))
+    if br >= m:
+        return m
+    return max(8, br - br % 8) if br >= 8 else br
+
+
+def stage_row_tile(m: int, rest: tuple, itemsize: int) -> int:
+    """Row-tile for the standard 3-buffer reduce staging (fp32 accumulator +
+    wire-dtype in + wire-dtype out tiles of shape ``(br, *rest)``)."""
+    rest_elems = 1
+    for d in rest:
+        rest_elems *= d
+    return row_tile(m, rest_elems * (4 + 2 * itemsize))
+
+
+def reduce_rows_tiled(x_ref, x_off, staging, stage_idx, dst_ref, dst_off, *,
+                      m, br, acc_ref, tmp_ref, out_ref, copy_sem):
+    """Row-tiled fp32 accumulate shared by the ring RS / two-shot AR kernels:
+    ``dst_ref[dst_off+r] = x_ref[x_off+r] (+ staging[stage_idx][r])`` with
+    VMEM held to ``(br, ...)`` tiles (ADVICE r1 VMEM-budget fix).
+    ``stage_idx=None`` skips the staged addend (ring step 0)."""
+    for t in range(pl.cdiv(m, br)):
+        rows = min(br, m - t * br)
+        acc = acc_ref.at[pl.ds(0, rows)]
+        tmp = tmp_ref.at[pl.ds(0, rows)]
+        out = out_ref.at[pl.ds(0, rows)]
+        local_copy(x_ref.at[pl.ds(x_off + t * br, rows)], tmp, copy_sem)
+        acc[...] = tmp[...].astype(jnp.float32)
+        if stage_idx is not None:
+            local_copy(staging.at[stage_idx, pl.ds(t * br, rows)], tmp,
+                       copy_sem)
+            acc[...] += tmp[...].astype(jnp.float32)
+        out[...] = acc[...].astype(out_ref.dtype)
+        local_copy(out, dst_ref.at[pl.ds(dst_off + t * br, rows)], copy_sem)
 
 
 def make_pallas_call(kernel, *, out_shape, in_specs, out_specs, scratch_shapes,

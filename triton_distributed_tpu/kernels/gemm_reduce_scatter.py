@@ -51,17 +51,24 @@ class GEMMRSConfig:
         return n // self.block_n
 
 
-def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_buf,
-                    acc_ref, tmp_ref, send_sems, recv_sems, copy_sem, *,
-                    axis: str, world: int, n_tiles: int, bn: int):
+def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
+                    acc_tile, tmp_tile, out_tile, send_sems, recv_sems,
+                    copy_sem, *, axis: str, world: int, n_tiles: int, bn: int):
     s = pl.program_id(0)
     j = pl.program_id(1)
     me = me_ref[0]
     m = o_ref.shape[0]
     # Remote segments first (their pushes overlap later compute); own last.
     dst = jax.lax.rem(me + 1 + s, world)
-    parity = jax.lax.rem(s, 2)
     is_own = s == world - 1
+    # VMEM staging is per n-TILE (ADVICE r1: full-segment staging blew the
+    # ~16MB budget at target shapes): each remote tile is pushed to its owner
+    # as soon as its partial product is done, from a parity-double-buffered
+    # (2, m, bn) slot. ``t`` counts remote tiles globally (own segment last,
+    # so remote tiles occupy t = 0 .. (world-1)*n_tiles - 1 contiguously).
+    t = s * n_tiles + j
+    parity = jax.lax.rem(t, 2)
+    total_remote = (world - 1) * n_tiles
 
     @pl.when((s == 0) & (j == 0))
     def _startup():
@@ -72,44 +79,57 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_buf,
     def _load():
         common.local_copy(a_ref.at[pl.ds(dst * m, m)], a_vmem, copy_sem)
 
-    # Reusing a send_buf parity slot: its push (started at segment s-2) must
-    # have drained.
-    @pl.when((j == 0) & (s >= 2) & ~is_own)
+    # Reusing a send_tile parity slot: its push (started at tile t-2, same
+    # parity) must have locally drained.
+    @pl.when(~is_own & (t >= 2))
     def _reclaim():
-        common.wait_recv(send_buf.at[parity], send_sems.at[s - 2])
+        common.wait_recv(send_tile.at[parity], send_sems.at[parity])
 
     partial = jnp.dot(a_vmem[...], b_ref[...],
                       preferred_element_type=jnp.float32)
 
+    # Tile complete -> push it to its owner's staging column immediately
+    # (async; overlaps every later matmul — the reference's per-tile notify +
+    # rs_stream, at tile rather than segment granularity).
     @pl.when(~is_own)
-    def _stage_remote():
-        send_buf[parity, :, pl.dslice(j * bn, bn)] = partial.astype(send_buf.dtype)
-
-    @pl.when(is_own)
-    def _stage_own():
-        acc_ref[:, pl.dslice(j * bn, bn)] = partial
-
-    # Segment complete -> push the partial to its owner (async; overlaps the
-    # next segments' matmuls — the reference's per-tile notify + rs_stream).
-    @pl.when((j == n_tiles - 1) & ~is_own)
-    def _push():
+    def _push_tile():
+        send_tile[parity] = partial.astype(send_tile.dtype)
         common.remote_copy(
-            send_buf.at[parity], staging.at[me],
-            send_sems.at[s], recv_sems.at[me], axis, dst)
+            send_tile.at[parity], staging.at[me, :, pl.ds(j * bn, bn)],
+            send_sems.at[parity], recv_sems.at[me], axis, dst)
 
-    # Final step: fold in the world-1 remote partials for our segment.
-    @pl.when(is_own & (j == n_tiles - 1))
-    def _reduce():
-        for i in range(world - 1):
-            src = jax.lax.rem(me + 1 + i, world)
-            common.wait_recv(staging.at[src], recv_sems.at[src])
-            common.local_copy(staging.at[src], tmp_ref, copy_sem)
-            acc_ref[...] += tmp_ref[...].astype(jnp.float32)
-        tmp_ref[...] = acc_ref[...].astype(tmp_ref.dtype)
-        common.local_copy(tmp_ref, o_ref, copy_sem)
-        # Drain sends not reclaimed by the parity rotation (the last two).
-        for i in range(max(0, world - 3), world - 1):
-            common.wait_recv(send_buf.at[0], send_sems.at[i])
+    # Own segment (last): fold the world-1 remote partials per tile, in a
+    # FIXED global rank order so the reduction bits are rank-independent
+    # (ADVICE r1: rank-relative order made replicated collectives diverge).
+    @pl.when(is_own)
+    def _own_segment():
+        @pl.when(j == 0)
+        def _arrivals():
+            for src in range(world):
+                @pl.when(src != me)
+                def _wait(src=src):
+                    common.wait_recv(staging.at[src], recv_sems.at[src])
+
+        acc_tile[...] = jnp.zeros_like(acc_tile)
+        for src in range(world):
+            @pl.when(src == me)
+            def _add_own():
+                acc_tile[...] += partial
+
+            @pl.when(src != me)
+            def _add_remote(src=src):
+                common.local_copy(staging.at[src, :, pl.ds(j * bn, bn)],
+                                  tmp_tile, copy_sem)
+                acc_tile[...] += tmp_tile[...].astype(jnp.float32)
+        out_tile[...] = acc_tile[...].astype(out_tile.dtype)
+        common.local_copy(out_tile, o_ref.at[:, pl.ds(j * bn, bn)], copy_sem)
+
+        # Drain the last push per parity slot (every earlier push was
+        # reclaimed by the t-2 wait above).
+        @pl.when(j == n_tiles - 1)
+        def _drain():
+            for p in range(min(2, total_remote)):
+                common.wait_recv(send_tile.at[p], send_sems.at[p])
 
 
 def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
@@ -123,9 +143,10 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
     _, n = b_local.shape
     if world == 1:
         from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip
-        return ag_gemm_single_chip(a_local, b_local,
-                                   block_n=min(config.block_n, n),
-                                   interpret=interpret)
+        # No block override: an explicit block would forfeit the automatic
+        # XLA delegation on ragged/VMEM-infeasible shapes (world==1 is the
+        # degenerate path; config.block_n tiles the multi-device grid only).
+        return ag_gemm_single_chip(a_local, b_local, interpret=interpret)
     if M % world:
         raise ValueError(f"M {M} not divisible by world {world}")
     m = M // world
@@ -144,13 +165,14 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),              # (m, N)
         scratch_shapes=[
-            pltpu.HBM((world, m, n), out_dtype),    # incoming partials
-            pltpu.VMEM((m, k_local), a_local.dtype),
-            pltpu.VMEM((2, m, n), out_dtype),       # send double-buffer
-            pltpu.VMEM((m, n), jnp.float32),        # own-segment accumulator
-            pltpu.VMEM((m, n), out_dtype),
-            common.dma_sems(world - 1),
-            common.dma_sems(world),
+            pltpu.HBM((world, m, n), out_dtype),      # incoming partials
+            pltpu.VMEM((m, k_local), a_local.dtype),  # dst-segment A rows
+            pltpu.VMEM((2, m, bn), out_dtype),        # per-tile send buffer
+            pltpu.VMEM((m, bn), jnp.float32),         # own-tile accumulator
+            pltpu.VMEM((m, bn), out_dtype),           # remote-partial tile
+            pltpu.VMEM((m, bn), out_dtype),           # cast-out tile
+            common.dma_sems(2),                       # send (by tile parity)
+            common.dma_sems(world),                   # recv (slot per src)
             pltpu.SemaphoreType.DMA(()),
         ],
     )

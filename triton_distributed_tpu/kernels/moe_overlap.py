@@ -40,24 +40,26 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     """AG of sequence-sharded tokens + per-expert grouped GEMM.
 
     x_local (m, d), topk_ids_local (m, k), w_up_local (E, d, f_local)
-    -> (grouped (E, expert_capacity, f_local), expert_counts, src_idx):
-    every device computes all experts over the *gathered* tokens against its
-    f-shard of each expert's weight (column-parallel MoE up-projection,
-    reference ``ag_group_gemm`` allgather_group_gemm.py:398).
+    -> (grouped (E, expert_capacity, f_local), expert_counts, src_idx,
+    n_dropped): every device computes all experts over the *gathered* tokens
+    against its f-shard of each expert's weight (column-parallel MoE
+    up-projection, reference ``ag_group_gemm`` allgather_group_gemm.py:398).
+    ``n_dropped`` counts (token, k) pairs lost to ``expert_capacity``
+    overflow — observable, never silent (ADVICE r1).
     """
     x_full = ring_all_gather(x_local, axis=axis, interpret=interpret)
     ids_full = ring_all_gather(topk_ids_local, axis=axis, interpret=interpret)
     M, k = ids_full.shape
     flat_ids = ids_full.reshape(M * k)
     # Group (token, k) pairs by expert (the role of the csrc alignment op).
-    grouped, counts, src_idx = moe_utils.tokens_by_local_expert(
+    grouped, counts, src_idx, n_dropped = moe_utils.tokens_by_local_expert(
         jnp.repeat(x_full, k, axis=0)[None],        # (1, M*k, d) capacity grid
         flat_ids[None],
         jnp.asarray([M * k], jnp.int32),
         n_local_experts=n_experts, expert_base=0,
         expert_capacity=expert_capacity)
     out = moe_utils.grouped_gemm(grouped, w_up_local)
-    return out, counts, src_idx
+    return out, counts, src_idx, n_dropped
 
 
 def moe_reduce_rs_device(expert_out, src_idx, topk_weights_full, w_down_local,
@@ -85,7 +87,7 @@ def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
                       interpret=None):
     """Full MoE-TP MLP: AG -> GroupGEMM(up) -> act -> GroupGEMM(down) ->
     topk-reduce -> RS (the reference's "AG MoE" tutorial pipeline)."""
-    up, counts, src_idx = ag_group_gemm_device(
+    up, counts, src_idx, _ = ag_group_gemm_device(
         x_local, topk_ids_local, w_up_local, n_experts=n_experts,
         expert_capacity=expert_capacity, axis=axis, interpret=interpret)
     act = activation(up.astype(jnp.float32)).astype(up.dtype)

@@ -36,6 +36,7 @@ class RoutingPlan:
     kept: jax.Array         # (n*k,) bool: False where capacity overflowed
     expert: jax.Array       # (n*k,) global expert id per sorted flat token
     topk_weight: jax.Array  # (n*k,) routing weight per sorted flat token
+    n_dropped: jax.Array    # () int32: (token, k) pairs lost to capacity
 
 
 def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
@@ -45,7 +46,9 @@ def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
 
     Overflowing tokens (more than ``capacity`` for one destination) are
     dropped via ``kept`` — the static-shape analog of the reference growing
-    its symmetric buffers (sp_flash_decode_layer.py:116-130)."""
+    its symmetric buffers (sp_flash_decode_layer.py:116-130). The loss is
+    NOT silent: ``plan.n_dropped`` counts the dropped (token, k) pairs so
+    callers can detect overflow and re-size capacity (ADVICE r1)."""
     if n_experts % world:
         raise ValueError(f"n_experts {n_experts} not divisible by world {world}")
     epr = n_experts // world
@@ -63,7 +66,8 @@ def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
                        slot=jnp.where(kept, slot, 0),
                        counts=jnp.minimum(counts, capacity), kept=kept,
                        expert=flat_expert[order],
-                       topk_weight=flat_weight[order])
+                       topk_weight=flat_weight[order],
+                       n_dropped=jnp.sum(~kept).astype(jnp.int32))
 
 
 def scatter_to_capacity(x, plan: RoutingPlan, *, world: int, capacity: int):
@@ -103,9 +107,10 @@ def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
     (n_local_experts, expert_capacity, hidden) for the grouped GEMM, plus the
     inverse indices to put results back.
 
-    Returns (grouped, grouped_valid, src_flat_idx) where src_flat_idx maps
-    each grouped slot back to its flat position in the recv layout (-1 =
-    empty)."""
+    Returns (grouped, grouped_valid, src_flat_idx, n_dropped) where
+    src_flat_idx maps each grouped slot back to its flat position in the recv
+    layout (-1 = empty) and n_dropped counts valid arrivals lost to
+    ``expert_capacity`` overflow (ADVICE r1: overflow must be observable)."""
     world, cap, hidden = recv_tokens.shape
     flat = recv_tokens.reshape(world * cap, hidden)
     ids = recv_ids.reshape(world * cap)
@@ -127,7 +132,9 @@ def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
     src_flat_idx = jnp.full((n_local_experts, expert_capacity), -1, jnp.int32)
     src_flat_idx = src_flat_idx.at[e_idx, slot].set(
         order.astype(jnp.int32), mode="drop")
-    return grouped, jnp.minimum(counts, expert_capacity), src_flat_idx
+    n_dropped = jnp.sum((local_sorted < n_local_experts) & ~kept
+                        ).astype(jnp.int32)
+    return grouped, jnp.minimum(counts, expert_capacity), src_flat_idx, n_dropped
 
 
 def scatter_back_from_experts(expert_out, src_flat_idx, *, world: int,

@@ -43,7 +43,8 @@ from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
 def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
-                       acc_ref, tmp_ref, out_vmem, *, axis: str, world: int):
+                       acc_ref, tmp_ref, out_vmem, *, axis: str, world: int,
+                       br: int):
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
 
@@ -58,20 +59,31 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
-    # Own contribution seeds the accumulator (overlaps with DMA traffic).
-    common.local_copy(x_ref.at[pl.ds(me * m, m)], tmp_ref, copy_sem)
-    acc_ref[...] = tmp_ref[...].astype(jnp.float32)
+    # Own contribution into its FIXED staging slot: all ranks then reduce in
+    # the same global order 0..world-1, so the op is a deterministic,
+    # rank-independent function of its inputs (ADVICE r1).
+    common.local_copy(x_ref.at[pl.ds(me * m, m)], staging.at[me], copy_sem)
+    for src in range(world):
+        @pl.when(src != me)
+        def _wait(src=src):
+            common.wait_recv(staging.at[src], recv_sems.at[src])
 
-    # Reduce arrivals as they land (fixed slot order; sems make it safe in any
-    # physical arrival order).
-    for i in range(world - 1):
-        src = jax.lax.rem(me + 1 + i, world)
-        common.wait_recv(staging.at[src], recv_sems.at[src])
-        common.local_copy(staging.at[src], tmp_ref, copy_sem)
-        acc_ref[...] += tmp_ref[...].astype(jnp.float32)
-
-    out_vmem[...] = acc_ref[...].astype(out_vmem.dtype)
-    common.local_copy(out_vmem, o_ref, copy_sem)
+    # Row-tiled accumulate: VMEM holds (br, ...) tiles, not the full chunk
+    # (ADVICE r1: full-shape VMEM staging blew the budget at target shapes).
+    for t in range(pl.cdiv(m, br)):
+        rows = min(br, m - t * br)
+        rs = pl.ds(t * br, rows)
+        acc = acc_ref.at[pl.ds(0, rows)]
+        tmp = tmp_ref.at[pl.ds(0, rows)]
+        out = out_vmem.at[pl.ds(0, rows)]
+        for src in range(world):
+            common.local_copy(staging.at[src, rs], tmp, copy_sem)
+            if src == 0:
+                acc[...] = tmp[...].astype(jnp.float32)
+            else:
+                acc[...] += tmp[...].astype(jnp.float32)
+        out[...] = acc[...].astype(out_vmem.dtype)
+        common.local_copy(out, o_ref.at[rs], copy_sem)
     for dma in sends:
         dma.wait_send()
 
@@ -81,28 +93,31 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
 # ---------------------------------------------------------------------------
 
 
-def _ring_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
-                    acc_ref, tmp_ref, send_buf, *, axis: str, world: int):
+def _ring_rs_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
+                    copy_sem, acc_ref, tmp_ref, out_vmem, *, axis: str,
+                    world: int, br: int):
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
     right = jax.lax.rem(me + 1, world)
 
     dl.barrier_all(axis)
 
+    def reduce_chunk(x_off, stage_idx, dst_ref, dst_off):
+        common.reduce_rows_tiled(
+            x_ref, x_off, staging, stage_idx, dst_ref, dst_off, m=m, br=br,
+            acc_ref=acc_ref, tmp_ref=tmp_ref, out_ref=out_vmem,
+            copy_sem=copy_sem)
+
     for s in range(world - 1):
         c = jax.lax.rem(me - s - 1 + world, world)  # chunk forwarded at step s
-        common.local_copy(x_ref.at[pl.ds(c * m, m)], tmp_ref, copy_sem)
-        acc = tmp_ref[...].astype(jnp.float32)
         if s > 0:
             # Partial sum of chunk c from the left (arrived at step s-1).
             common.wait_recv(staging.at[s - 1], recv_sems.at[s - 1])
-            common.local_copy(staging.at[s - 1], tmp_ref, copy_sem)
-            acc += tmp_ref[...].astype(jnp.float32)
-        send_buf[...] = acc.astype(send_buf.dtype)
+        reduce_chunk(c * m, s - 1 if s > 0 else None, send_hbm, 0)
         dma = common.remote_copy(
-            send_buf, staging.at[s],
+            send_hbm, staging.at[s],
             send_sems.at[s], recv_sems.at[s], axis, right)
-        # send_buf is rewritten next step: wait local drain now. The ring is
+        # send_hbm is rewritten next step: wait local drain now. The ring is
         # latency-bound by the recv dependency anyway (pipelining across
         # sub-chunks is the further optimization, as in the reference's
         # ring CE variants).
@@ -110,13 +125,8 @@ def _ring_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
 
     # Final arrival completes own segment: sum over all other ranks of chunk
     # ``me``, plus our own contribution.
-    common.local_copy(x_ref.at[pl.ds(me * m, m)], tmp_ref, copy_sem)
-    acc = tmp_ref[...].astype(jnp.float32)
     common.wait_recv(staging.at[world - 2], recv_sems.at[world - 2])
-    common.local_copy(staging.at[world - 2], tmp_ref, copy_sem)
-    acc += tmp_ref[...].astype(jnp.float32)
-    send_buf[...] = acc.astype(send_buf.dtype)
-    common.local_copy(send_buf, o_ref, copy_sem)
+    reduce_chunk(me * m, world - 2, o_ref, 0)
 
 
 # ---------------------------------------------------------------------------
@@ -133,21 +143,28 @@ def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
         raise ValueError(f"leading dim {x_local.shape[0]} not divisible by world {world}")
     m = x_local.shape[0] // world
     rest = x_local.shape[1:]
-    n_staging = world if n_staging_key == "oneshot" else world - 1
+    br = common.stage_row_tile(m, rest, x_local.dtype.itemsize)
+    oneshot = n_staging_key == "oneshot"
+    n_staging = world if oneshot else world - 1
+    scratch = [
+        pltpu.HBM((n_staging, m, *rest), x_local.dtype),   # staging
+    ]
+    if not oneshot:
+        scratch.append(pltpu.HBM((m, *rest), x_local.dtype))  # ring send
+    scratch += [
+        common.dma_sems(world),                            # send
+        common.dma_sems(world),                            # recv
+        pltpu.SemaphoreType.DMA(()),                       # local copies
+        pltpu.VMEM((br, *rest), jnp.float32),              # accumulator tile
+        pltpu.VMEM((br, *rest), x_local.dtype),            # copy-in tile
+        pltpu.VMEM((br, *rest), x_local.dtype),            # cast-out tile
+    ]
     return common.make_pallas_call(
-        functools.partial(kernel, axis=axis, world=world),
+        functools.partial(kernel, axis=axis, world=world, br=br),
         out_shape=jax.ShapeDtypeStruct((m, *rest), x_local.dtype),
         in_specs=[common.any_spec()],
         out_specs=common.any_spec(),
-        scratch_shapes=[
-            pltpu.HBM((n_staging, m, *rest), x_local.dtype),   # staging
-            common.dma_sems(world),                            # send
-            common.dma_sems(world),                            # recv
-            pltpu.SemaphoreType.DMA(()),                       # local copies
-            pltpu.VMEM((m, *rest), jnp.float32),               # accumulator
-            pltpu.VMEM((m, *rest), x_local.dtype),             # copy-in staging
-            pltpu.VMEM((m, *rest), x_local.dtype),             # wire/out buffer
-        ],
+        scratch_shapes=scratch,
         collective_id=collective_id,
         interpret=interpret,
     )(x_local)

@@ -51,7 +51,17 @@ class EPAll2AllLayer:
     def dispatch(self, x, topk_ids, topk_weights, *, interpret=None):
         """Per-device. x: (n, hidden); topk_ids/weights: (n, topk).
         Returns (grouped (E_local, expert_cap, hidden), expert_counts,
-        state) — state threads to ``combine``."""
+        state) — state threads to ``combine``.
+
+        Drop semantics: with static capacities, (token, k) pairs beyond
+        ``capacity`` per destination rank — or beyond ``expert_capacity``
+        per local expert after arrival — are dropped (their contribution to
+        the combined output is zero; the remaining duplicates still count).
+        This is the static-shape analog of the reference growing its
+        symmetric buffers. The loss is surfaced, not silent:
+        ``state['stats']`` holds ``n_dropped_dispatch`` (this rank's
+        routing overflow) and ``n_dropped_expert`` (arrival overflow);
+        callers size capacities from those counters (ADVICE r1)."""
         world = jax.lax.axis_size(self.axis)
         me = jax.lax.axis_index(self.axis)
         n_local = self.n_experts // world
@@ -64,12 +74,15 @@ class EPAll2AllLayer:
         (recv, recv_ids), rcounts = fast_all_to_all(
             (send, ids), plan.counts.astype(jnp.int32), ctx=self.ctx(),
             interpret=interpret)
-        grouped, expert_counts, src_idx = moe_utils.tokens_by_local_expert(
-            recv, recv_ids[:, :, 0], rcounts,
-            n_local_experts=n_local, expert_base=me * n_local,
-            expert_capacity=self.expert_capacity)
+        grouped, expert_counts, src_idx, n_drop_e = (
+            moe_utils.tokens_by_local_expert(
+                recv, recv_ids[:, :, 0], rcounts,
+                n_local_experts=n_local, expert_base=me * n_local,
+                expert_capacity=self.expert_capacity))
         state = {"plan": plan, "src_idx": src_idx, "rcounts": rcounts,
-                 "n_tokens": x.shape[0]}
+                 "n_tokens": x.shape[0],
+                 "stats": {"n_dropped_dispatch": plan.n_dropped,
+                           "n_dropped_expert": n_drop_e}}
         return grouped, expert_counts, state
 
     def combine(self, expert_out, state, *, interpret=None):
