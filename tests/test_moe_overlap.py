@@ -33,13 +33,14 @@ def test_ag_moe_mlp_vs_golden(mesh8, rng):
         return ag_moe_mlp_device(x, ids_l, w_l, wu_l, wd_l, n_experts=E,
                                  expert_capacity=ecap)
 
-    out = jax.jit(jax.shard_map(
+    out, n_dropped = jax.jit(jax.shard_map(
         per_device, mesh=mesh8,
         in_specs=(P("tp", None), P("tp", None), P("tp", None), P(), P()),
-        out_specs=P("tp", None),
+        out_specs=(P("tp", None), P()),
         check_vma=False,
     ))(jnp.asarray(xs), jnp.asarray(ids, jnp.int32), jnp.asarray(ws),
        jnp.asarray(w_up), jnp.asarray(w_down))
+    assert int(n_dropped) == 0
 
     golden = np.zeros((M, d), np.float32)
     for t in range(M):

@@ -77,31 +77,17 @@ def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
-    # Own contribution into its FIXED staging slot so every rank reduces in
-    # the same global order 0..world-1 — the replicated output is bitwise
-    # identical across ranks (ADVICE r1: rank-relative order diverged).
-    common.local_copy(x_ref, staging.at[me], copy_sem)
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
             common.wait_recv(staging.at[src], recv_sems.at[src])
 
-    # Row-tiled accumulate: VMEM holds (br, ...) tiles, not the full shape
-    # (ADVICE r1: 3 full-shape VMEM buffers blew the budget at target shapes).
-    for t in range(pl.cdiv(m, br)):
-        rows = min(br, m - t * br)
-        rs = pl.ds(t * br, rows)
-        acc = acc_ref.at[pl.ds(0, rows)]
-        tmp = tmp_ref.at[pl.ds(0, rows)]
-        out = out_vmem.at[pl.ds(0, rows)]
-        for src in range(world):
-            common.local_copy(staging.at[src, rs], tmp, copy_sem)
-            if src == 0:
-                acc[...] = tmp[...].astype(jnp.float32)
-            else:
-                acc[...] += tmp[...].astype(jnp.float32)
-        out[...] = acc[...].astype(out_vmem.dtype)
-        common.local_copy(out, o_ref.at[rs], copy_sem)
+    # Fixed global reduce order 0..world-1 (own contribution read straight
+    # from x_ref at its slot) — the replicated output is bitwise identical
+    # across ranks (ADVICE r1: rank-relative order diverged); row-tiled VMEM.
+    common.reduce_slots_tiled(
+        x_ref, 0, staging, world, me, o_ref, m=m, br=br, acc_ref=acc_ref,
+        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem)
     for dma in sends:
         dma.wait_send()
 

@@ -117,6 +117,37 @@ def stage_row_tile(m: int, rest: tuple, itemsize: int) -> int:
     return row_tile(m, rest_elems * (4 + 2 * itemsize))
 
 
+def reduce_slots_tiled(x_ref, x_off, staging, world, me, o_ref, *, m, br,
+                       acc_ref, tmp_ref, out_ref, copy_sem):
+    """Row-tiled fp32 reduce in FIXED global rank order (src = 0..world-1,
+    bitwise rank-independent) shared by the one-shot AR / RS kernels:
+    the own contribution reads straight from ``x_ref[x_off:]`` (no staging
+    round-trip), remote ones from ``staging[src]``; result rows land in
+    ``o_ref[0:m]``. VMEM held to ``(br, ...)`` tiles (ADVICE r1)."""
+    for t in range(pl.cdiv(m, br)):
+        rows = min(br, m - t * br)
+        acc = acc_ref.at[pl.ds(0, rows)]
+        tmp = tmp_ref.at[pl.ds(0, rows)]
+        out = out_ref.at[pl.ds(0, rows)]
+        for src in range(world):
+            @pl.when(src == me)
+            def _own(t=t, rows=rows):
+                local_copy(x_ref.at[pl.ds(x_off + t * br, rows)],
+                           tmp_ref.at[pl.ds(0, rows)], copy_sem)
+
+            @pl.when(src != me)
+            def _remote(src=src, t=t, rows=rows):
+                local_copy(staging.at[src, pl.ds(t * br, rows)],
+                           tmp_ref.at[pl.ds(0, rows)], copy_sem)
+
+            if src == 0:
+                acc[...] = tmp[...].astype(jnp.float32)
+            else:
+                acc[...] += tmp[...].astype(jnp.float32)
+        out[...] = acc[...].astype(out_ref.dtype)
+        local_copy(out, o_ref.at[pl.ds(t * br, rows)], copy_sem)
+
+
 def reduce_rows_tiled(x_ref, x_off, staging, stage_idx, dst_ref, dst_off, *,
                       m, br, acc_ref, tmp_ref, out_ref, copy_sem):
     """Row-tiled fp32 accumulate shared by the ring RS / two-shot AR kernels:

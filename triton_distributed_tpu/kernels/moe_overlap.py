@@ -86,8 +86,10 @@ def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
                       activation=jax.nn.silu, axis: str = "tp",
                       interpret=None):
     """Full MoE-TP MLP: AG -> GroupGEMM(up) -> act -> GroupGEMM(down) ->
-    topk-reduce -> RS (the reference's "AG MoE" tutorial pipeline)."""
-    up, counts, src_idx, _ = ag_group_gemm_device(
+    topk-reduce -> RS (the reference's "AG MoE" tutorial pipeline).
+    Returns (out (m, d), n_dropped): capacity overflow zeroes the dropped
+    pairs' contribution but is observable, never silent (ADVICE r1)."""
+    up, counts, src_idx, n_dropped = ag_group_gemm_device(
         x_local, topk_ids_local, w_up_local, n_experts=n_experts,
         expert_capacity=expert_capacity, axis=axis, interpret=interpret)
     act = activation(up.astype(jnp.float32)).astype(up.dtype)
@@ -95,6 +97,7 @@ def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
                              interpret=interpret)
     m, k = topk_ids_local.shape
     world = jax.lax.axis_size(axis)
-    return moe_reduce_rs_device(
+    out = moe_reduce_rs_device(
         act, src_idx, w_full, w_down_local, n_tokens=world * m, topk=k,
         axis=axis, interpret=interpret)
+    return out, n_dropped

@@ -59,31 +59,16 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
-    # Own contribution into its FIXED staging slot: all ranks then reduce in
-    # the same global order 0..world-1, so the op is a deterministic,
-    # rank-independent function of its inputs (ADVICE r1).
-    common.local_copy(x_ref.at[pl.ds(me * m, m)], staging.at[me], copy_sem)
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
             common.wait_recv(staging.at[src], recv_sems.at[src])
 
-    # Row-tiled accumulate: VMEM holds (br, ...) tiles, not the full chunk
-    # (ADVICE r1: full-shape VMEM staging blew the budget at target shapes).
-    for t in range(pl.cdiv(m, br)):
-        rows = min(br, m - t * br)
-        rs = pl.ds(t * br, rows)
-        acc = acc_ref.at[pl.ds(0, rows)]
-        tmp = tmp_ref.at[pl.ds(0, rows)]
-        out = out_vmem.at[pl.ds(0, rows)]
-        for src in range(world):
-            common.local_copy(staging.at[src, rs], tmp, copy_sem)
-            if src == 0:
-                acc[...] = tmp[...].astype(jnp.float32)
-            else:
-                acc[...] += tmp[...].astype(jnp.float32)
-        out[...] = acc[...].astype(out_vmem.dtype)
-        common.local_copy(out, o_ref.at[rs], copy_sem)
+    # Fixed global reduce order 0..world-1 (own chunk read straight from
+    # x_ref): deterministic, rank-independent bits (ADVICE r1); row-tiled.
+    common.reduce_slots_tiled(
+        x_ref, me * m, staging, world, me, o_ref, m=m, br=br, acc_ref=acc_ref,
+        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem)
     for dma in sends:
         dma.wait_send()
 

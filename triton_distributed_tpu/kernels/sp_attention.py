@@ -176,27 +176,159 @@ def _single_device_attn(q, k, v, *, causal: bool, scale: float):
 # ---------------------------------------------------------------------------
 
 
+def _flash_decode_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                         acc_ref, m_ref, l_ref, *, n_chunks: int, ck: int,
+                         scale: float, n_kv: int, bshd: bool):
+    """Split-KV streaming-softmax decode step for one batch row: the grid
+    walks KV chunks; per chunk, for each local kv head (static unroll — the
+    per-block head dim must span the full array for Mosaic's last-two-dims
+    block rule), the MXU computes the (g, ck) score block (g = GQA group of
+    q heads sharing that kv head), rescales the running (acc, max, denom)
+    triple, and the final chunk emits (out, LSE). The structure of the
+    reference's split-KV kernel (flash_decode.py:130) with the chunk loop as
+    the Pallas grid instead of persistent CTAs."""
+    c = pl.program_id(1)
+    kv_len = kvlen_ref[0]
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    for h in range(n_kv):
+        q = q_ref[0, h].astype(jnp.float32)                # (g, dh)
+        if bshd:
+            k = k_ref[0, :, h, :].astype(jnp.float32)      # (ck, dh)
+            v = v_ref[0, :, h, :].astype(jnp.float32)
+        else:
+            k = k_ref[0, h].astype(jnp.float32)
+            v = v_ref[0, h].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ()))) * scale        # (g, ck)
+        pos = c * ck + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        valid = pos < kv_len
+        scores = jnp.where(valid, scores, _NEG_INF)
+        seg_max = jnp.max(scores, axis=-1, keepdims=True)
+        new_max = jnp.maximum(m_ref[h], seg_max)
+        corr = jnp.exp(m_ref[h] - new_max)
+        # ``* valid``: a fully-masked chunk has scores == new_max == _NEG_INF
+        # and exp(0) == 1 would poison the denominator.
+        p = jnp.exp(scores - new_max) * valid.astype(jnp.float32)
+        l_ref[h] = l_ref[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))                # (g, dh)
+        m_ref[h] = new_max
+
+    @pl.when(c == n_chunks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)             # (n_kv, g, 1)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[...] + jnp.log(denom))[..., 0]
+
+
+def _kv_chunk(m_kv: int, preferred: int = 512) -> int:
+    """Largest 8-aligned (sublane) divisor of the KV shard length <= the
+    preference; the full length when none exists (always legal)."""
+    for cand in range(min(preferred, m_kv), 7, -1):
+        if m_kv % cand == 0 and cand % 8 == 0:
+            return cand
+    return m_kv
+
+
+def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
+                       scale: float | None = None, chunk: int = 512,
+                       kv_layout: str = "bhsd", interpret=None):
+    """Single-device split-KV GQA decode partial via the Pallas kernel.
+
+    q: (B, Hq, dh); k/v_cache: (B, Hkv, m_kv, dh) — or (B, m_kv, Hkv, dh)
+    with ``kv_layout="bshd"`` (the TP cache layout; the BlockSpec index map
+    absorbs the layout, no transpose materializes). Hq % Hkv == 0 (GQA stays
+    native — no KV head expansion materializes). ``kv_len`` (int32 scalar)
+    masks cache positions >= it (preallocated-cache decode); None = full.
+    Returns (out (B, Hq, dh) fp32, lse (B, Hq) fp32) — the split-KV partial
+    pair the inter-rank combine merges (reference flash_decode.py:130/:482).
+    """
+    B, Hq, dh = q.shape
+    bshd = kv_layout == "bshd"
+    if kv_layout == "bhsd":
+        _, Hkv, m_kv, _ = k_cache.shape
+    elif bshd:
+        _, m_kv, Hkv, _ = k_cache.shape
+    else:
+        raise ValueError(f"unknown kv_layout {kv_layout!r}")
+    if Hq % Hkv:
+        raise ValueError(f"q heads {Hq} not divisible by kv heads {Hkv}")
+    g = Hq // Hkv
+    scale = dh ** -0.5 if scale is None else scale
+    # Chunk preference bounded so the double-buffered all-heads K+V blocks
+    # stay under the staging budget.
+    per_pos = Hkv * dh * k_cache.dtype.itemsize * 4
+    ck = _kv_chunk(m_kv, min(chunk, max(8, common.VMEM_STAGE_BUDGET // per_pos)))
+    n_chunks = m_kv // ck
+    kv_len = jnp.asarray(
+        m_kv if kv_len is None else kv_len, jnp.int32).reshape(1)
+
+    # Blocks span ALL local kv heads: Mosaic requires the last two block dims
+    # be 8/128-divisible or equal to the full array dims; per-head blocks in
+    # the bshd layout would put a size-1 block on the head dim (illegal).
+    if bshd:
+        kv_spec = pl.BlockSpec((1, ck, Hkv, dh), lambda b, c, kl: (b, c, 0, 0))
+    else:
+        kv_spec = pl.BlockSpec((1, Hkv, ck, dh), lambda b, c, kl: (b, 0, c, 0))
+
+    qg = q.reshape(B, Hkv, g, dh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, g, dh), lambda b, c, kl: (b, 0, 0, 0)),
+            kv_spec,
+            kv_spec,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, Hkv, g, dh), lambda b, c, kl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, g), lambda b, c, kl: (b, 0, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((Hkv, g, dh), jnp.float32),   # acc
+            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # running max
+            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # denominator
+        ],
+    )
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_decode_kernel, n_chunks=n_chunks, ck=ck,
+                          scale=scale, n_kv=Hkv, bshd=bshd),
+        out_shape=[
+            jax.ShapeDtypeStruct((B, Hkv, g, dh), jnp.float32),
+            jax.ShapeDtypeStruct((B, Hkv, g), jnp.float32),
+        ],
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=resolve_interpret(interpret),
+    )(kv_len, qg, k_cache, v_cache)
+    return out.reshape(B, Hq, dh), lse.reshape(B, Hq)
+
+
 def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
-                        scale: float | None = None, interpret=None):
+                        kv_len=None, scale: float | None = None,
+                        interpret=None):
     """Per-device distributed decode attention (composable inside shard_map).
 
-    q: (B, H, dh) replicated; k/v_cache_local: (B, H, m_kv, dh) — the KV
-    sequence dim sharded over ``axis``. Each device computes its split-KV
-    partial (out, LSE); partials are ring-allgathered and LSE-merged
-    (reference flash_decode.py:482 inter-rank combine).
+    q: (B, Hq, dh) replicated; k/v_cache_local: (B, Hkv, m_kv, dh) — the KV
+    sequence dim sharded over ``axis``, GQA-native (Hq % Hkv == 0). Each
+    device computes its split-KV partial (out, LSE) with the Pallas
+    streaming-softmax kernel; partials are ring-allgathered and LSE-merged
+    (reference flash_decode.py:482 inter-rank combine). ``kv_len`` is this
+    device's LOCAL valid cache length (callers with a global offset pass
+    ``clip(offset - me*m_kv, 0, m_kv)``).
     """
     world = jax.lax.axis_size(axis)
     B, H, dh = q.shape
-    scale = dh ** -0.5 if scale is None else scale
-
-    scores = jnp.einsum("bhd,bhnd->bhn", q.astype(jnp.float32),
-                        k_cache_local.astype(jnp.float32)) * scale
-    local_max = jnp.max(scores, axis=-1, keepdims=True)
-    p = jnp.exp(scores - local_max)
-    denom = jnp.sum(p, axis=-1, keepdims=True)
-    out_local = jnp.einsum("bhn,bhnd->bhd", p, v_cache_local.astype(jnp.float32))
-    out_local = out_local / denom
-    lse_local = (local_max + jnp.log(denom))[..., 0]       # (B, H)
+    out_local, lse_local = flash_decode_local(
+        q, k_cache_local, v_cache_local, kv_len=kv_len, scale=scale,
+        interpret=interpret)
 
     if world == 1:
         return out_local.astype(q.dtype)

@@ -6,15 +6,16 @@ TPU-native analog of the reference's ``layers/nvidia/sp_flash_decode_layer.py``
 inter-rank LSE combine).
 
 The adaptive buffer management disappears on TPU (static shapes; the gather
-staging is scoped per kernel call); GQA is handled by expanding KV heads to
-query heads before the split-KV partial — XLA fuses the broadcast into the
-einsum, so no extra HBM traffic materializes.
+staging is scoped per kernel call); GQA stays native — the split-KV Pallas
+kernel groups the q heads sharing each kv head into one (g, ck) MXU score
+block, so no KV head expansion ever materializes.
 """
 
 from __future__ import annotations
 
 import dataclasses
 
+import jax
 import jax.numpy as jnp
 
 from triton_distributed_tpu.kernels.sp_attention import flash_decode_device
@@ -36,12 +37,17 @@ class SpGQAFlashDecodeAttention:
                 f"q heads {self.num_q_heads} not divisible by kv heads "
                 f"{self.num_kv_heads}")
 
-    def __call__(self, q, k_cache_local, v_cache_local, *, interpret=None):
+    def __call__(self, q, k_cache_local, v_cache_local, *, kv_len=None,
+                 interpret=None):
         """q: (B, Hq, dh); k/v_cache_local: (B, Hkv, m_kv, dh) with the KV
-        sequence dim sharded over ``axis``. Returns (B, Hq, dh)."""
-        groups = self.num_q_heads // self.num_kv_heads
-        if groups > 1:
-            k_cache_local = jnp.repeat(k_cache_local, groups, axis=1)
-            v_cache_local = jnp.repeat(v_cache_local, groups, axis=1)
+        sequence dim sharded over ``axis``. ``kv_len`` is the GLOBAL valid
+        cache length (preallocated-cache decode) — each rank masks its own
+        shard slice; None = the full cache. Returns (B, Hq, dh)."""
+        local_len = None
+        if kv_len is not None:
+            m_kv = k_cache_local.shape[2]
+            me = jax.lax.axis_index(self.axis)
+            local_len = jnp.clip(kv_len - me * m_kv, 0, m_kv)
         return flash_decode_device(q, k_cache_local, v_cache_local,
-                                   axis=self.axis, interpret=interpret)
+                                   axis=self.axis, kv_len=local_len,
+                                   interpret=interpret)

@@ -126,11 +126,15 @@ class TPAttn:
 
     # -- shared core --------------------------------------------------------
 
-    def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world):
+    def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world,
+                     use_flash_decode: bool = True, interpret=None):
         """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
         output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
         cache-append -> GQA-attend pipeline shared by every mode
-        (reference tp_attn.py:217-233)."""
+        (reference tp_attn.py:217-233). Decode steps (L == 1) stream the KV
+        cache through the split-KV Pallas kernel unless
+        ``use_flash_decode=False`` (the xla golden mode stays dense jnp so
+        mode-equality tests compare kernel against reference math)."""
         B, L, _ = qkv.shape
         qs, kvs = self.sizes(world)
         dh = self.head_dim
@@ -147,7 +151,9 @@ class TPAttn:
         k_cache = nn.cache_update(k_cache, k, offset)
         v_cache = nn.cache_update(v_cache, v, offset)
         out = nn.attn_with_cache(q, k_cache, v_cache, offset,
-                                 scale=dh ** -0.5)
+                                 scale=dh ** -0.5,
+                                 use_flash_decode=use_flash_decode,
+                                 interpret=interpret)
         return out.reshape(B, L, qs), k_cache, v_cache
 
     # -- per-device forwards (inside shard_map) -----------------------------
@@ -163,7 +169,7 @@ class TPAttn:
             config=AGGEMMConfig(block_n=self.block_n), interpret=interpret)
         qkv = qkv.reshape(world * Bl, L, -1)
         out, k_cache, v_cache = self._qkv_to_attn(
-            params, qkv, k_cache, v_cache, offset, world)
+            params, qkv, k_cache, v_cache, offset, world, interpret=interpret)
         out = gemm_rs_device(
             out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
             config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
@@ -178,7 +184,7 @@ class TPAttn:
         B, L, d = x_full.shape
         qkv = x_full @ params["w_qkv"]
         out, k_cache, v_cache = self._qkv_to_attn(
-            params, qkv, k_cache, v_cache, offset, world)
+            params, qkv, k_cache, v_cache, offset, world, interpret=interpret)
         partial = out.reshape(B * L, -1) @ params["w_o"]
         out = oneshot_all_reduce(partial, axis=self.axis, interpret=interpret)
         return out.reshape(B, L, d), k_cache, v_cache
@@ -192,7 +198,8 @@ class TPAttn:
         qkv = x_full.reshape(world * Bl * L, d) @ params["w_qkv"]
         qkv = qkv.reshape(world * Bl, L, -1)
         out, k_cache, v_cache = self._qkv_to_attn(
-            params, qkv, k_cache, v_cache, offset, world)
+            params, qkv, k_cache, v_cache, offset, world,
+            use_flash_decode=False)
         partial = out.reshape(world * Bl * L, -1) @ params["w_o"]
         out = jax.lax.psum_scatter(partial, self.axis, scatter_dimension=0,
                                    tiled=True)
