@@ -1,22 +1,39 @@
 """AG-GroupGEMM / GroupGEMM-reduce-RS tests — analog of the reference's
 test_ag_moe.py and test_moe_reduce_rs.py (golden: dense per-token expert
-compute), 8-way on the virtual CPU mesh."""
+compute), 8-way on the virtual CPU mesh. Shapes honor the conftest
+interpreter ceiling: the gathered-grid staging (world, E, cap, d) per device
+must stay under 12KB."""
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.kernels.moe_overlap import ag_moe_mlp_device
+from triton_distributed_tpu.kernels.moe_overlap import (
+    ag_group_gemm_device,
+    ag_moe_mlp_device,
+)
 from triton_distributed_tpu.runtime import assert_allclose
 
 WORLD = 8
 
 
+def _moe_golden(xs, ids, ws, w_up, w_down):
+    M, d = xs.shape
+    golden = np.zeros((M, d), np.float32)
+    for t in range(M):
+        for j in range(ids.shape[1]):
+            e = ids[t, j]
+            h = xs[t] @ w_up[e]
+            h = h / (1.0 + np.exp(-h))
+            golden[t] += ws[t, j] * (h @ w_down[e])
+    return golden
+
+
 def test_ag_moe_mlp_vs_golden(mesh8, rng):
-    m, k, d, f, E = 2, 2, 16, 32, 4
+    m, k, d, f, E = 2, 2, 8, 64, 2
     M = WORLD * m
-    ecap = M * k  # no expert can overflow
+    cap = 8  # >= m*k: no (source, expert) pair can overflow; 8-aligned
 
     xs = rng.standard_normal((M, d), dtype=np.float32)
     ids = rng.integers(0, E, (M, k))
@@ -30,23 +47,53 @@ def test_ag_moe_mlp_vs_golden(mesh8, rng):
         me = jax.lax.axis_index("tp")
         wu_l = jax.lax.dynamic_slice(wu, (0, 0, me * f_local), (E, d, f_local))
         wd_l = jax.lax.dynamic_slice(wd, (0, me * f_local, 0), (E, f_local, d))
-        return ag_moe_mlp_device(x, ids_l, w_l, wu_l, wd_l, n_experts=E,
-                                 expert_capacity=ecap)
+        out, n_dropped = ag_moe_mlp_device(x, ids_l, w_l, wu_l, wd_l,
+                                           n_experts=E, capacity=cap)
+        return out, n_dropped[None]
 
     out, n_dropped = jax.jit(jax.shard_map(
         per_device, mesh=mesh8,
         in_specs=(P("tp", None), P("tp", None), P("tp", None), P(), P()),
-        out_specs=(P("tp", None), P()),
+        out_specs=(P("tp", None), P("tp")),
         check_vma=False,
     ))(jnp.asarray(xs), jnp.asarray(ids, jnp.int32), jnp.asarray(ws),
        jnp.asarray(w_up), jnp.asarray(w_down))
-    assert int(n_dropped) == 0
+    assert int(np.asarray(n_dropped).sum()) == 0
+    assert_allclose(out, _moe_golden(xs, ids, ws, w_up, w_down),
+                    atol=1e-3, rtol=1e-3)
 
-    golden = np.zeros((M, d), np.float32)
+
+def test_ag_group_gemm_layout_and_state(mesh8, rng):
+    """The fused AG-GroupGEMM output keeps per-source slot ranges: expert e,
+    rows [src*cap, src*cap + cap) hold source src's routed tokens times this
+    device's f-shard — verified against the dense gather + matmul."""
+    m, k, d, f, E = 2, 2, 8, 64, 2
+    M, cap = WORLD * m, 8
+    f_local = f // WORLD
+
+    xs = rng.standard_normal((M, d), dtype=np.float32)
+    ids = rng.integers(0, E, (M, k))
+    w_up = rng.standard_normal((E, d, f), dtype=np.float32) * 0.2
+
+    def per_device(x, ids_l, wu):
+        me = jax.lax.axis_index("tp")
+        wu_l = jax.lax.dynamic_slice(wu, (0, 0, me * f_local), (E, d, f_local))
+        up, state = ag_group_gemm_device(x, ids_l, wu_l, n_experts=E,
+                                         capacity=cap)
+        return up, state["slot"], state["kept"]
+
+    up, slot, kept = jax.jit(jax.shard_map(
+        per_device, mesh=mesh8,
+        in_specs=(P("tp", None), P("tp", None), P()),
+        out_specs=(P(None, None, "tp"), P("tp", None), P("tp", None)),
+        check_vma=False,
+    ))(jnp.asarray(xs), jnp.asarray(ids, jnp.int32), jnp.asarray(w_up))
+
+    up, slot, kept = map(np.asarray, (up, slot, kept))
+    assert kept.all()
     for t in range(M):
+        src, i = t // m, t % m
         for j in range(k):
             e = ids[t, j]
-            h = xs[t] @ w_up[e]
-            h = h / (1.0 + np.exp(-h))
-            golden[t] += ws[t, j] * (h @ w_down[e])
-    assert_allclose(out, golden, atol=1e-3, rtol=1e-3)
+            row = up[e, src * cap + slot[t, j]]
+            assert_allclose(row, xs[t] @ w_up[e], atol=1e-3, rtol=1e-3)

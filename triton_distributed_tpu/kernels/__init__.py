@@ -35,8 +35,9 @@ from triton_distributed_tpu.kernels.ep_all_to_all import (  # noqa: F401
     fast_all_to_all,
 )
 from triton_distributed_tpu.kernels.moe_overlap import (  # noqa: F401
+    MoEOverlapConfig,
     ag_group_gemm_device,
     ag_moe_mlp_device,
-    moe_reduce_rs_device,
+    group_gemm_rs_device,
 )
 from triton_distributed_tpu.kernels import moe_utils  # noqa: F401

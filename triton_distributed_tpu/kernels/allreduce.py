@@ -73,14 +73,15 @@ def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
         dma = common.remote_copy(
-            x_ref, staging.at[me],
+            x_ref, staging.at[common.peer_slot(me, peer)],
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
-            common.wait_recv(staging.at[src], recv_sems.at[src])
+            common.wait_recv(staging.at[common.peer_slot(src, me)],
+                             recv_sems.at[src])
 
     # Fixed global reduce order 0..world-1 (own contribution read straight
     # from x_ref at its slot) — the replicated output is bitwise identical
@@ -106,7 +107,7 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
         in_specs=[common.any_spec()],
         out_specs=common.any_spec(),
         scratch_shapes=[
-            pltpu.HBM((world, *shape), x_local.dtype),
+            pltpu.HBM((world - 1, *shape), x_local.dtype),  # remote arrivals
             common.dma_sems(world),
             common.dma_sems(world),
             pltpu.SemaphoreType.DMA(()),

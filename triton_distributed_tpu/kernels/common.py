@@ -117,13 +117,22 @@ def stage_row_tile(m: int, rest: tuple, itemsize: int) -> int:
     return row_tile(m, rest_elems * (4 + 2 * itemsize))
 
 
+def peer_slot(src, me):
+    """Slot index of source ``src`` in a (world-1)-slot receive staging that
+    omits the owner's own slot (sources in rank order, ``me`` removed).
+    Senders pushing to ``peer`` use ``peer_slot(me, peer)``; receivers read
+    source ``src`` at ``peer_slot(src, me)``."""
+    return src - (src > me)
+
+
 def reduce_slots_tiled(x_ref, x_off, staging, world, me, o_ref, *, m, br,
                        acc_ref, tmp_ref, out_ref, copy_sem):
     """Row-tiled fp32 reduce in FIXED global rank order (src = 0..world-1,
     bitwise rank-independent) shared by the one-shot AR / RS kernels:
     the own contribution reads straight from ``x_ref[x_off:]`` (no staging
-    round-trip), remote ones from ``staging[src]``; result rows land in
-    ``o_ref[0:m]``. VMEM held to ``(br, ...)`` tiles (ADVICE r1)."""
+    round-trip), remote ones from the (world-1)-slot ``staging`` at
+    ``peer_slot(src, me)``; result rows land in ``o_ref[0:m]``. VMEM held
+    to ``(br, ...)`` tiles (ADVICE r1)."""
     for t in range(pl.cdiv(m, br)):
         rows = min(br, m - t * br)
         acc = acc_ref.at[pl.ds(0, rows)]
@@ -137,7 +146,7 @@ def reduce_slots_tiled(x_ref, x_off, staging, world, me, o_ref, *, m, br,
 
             @pl.when(src != me)
             def _remote(src=src, t=t, rows=rows):
-                local_copy(staging.at[src, pl.ds(t * br, rows)],
+                local_copy(staging.at[peer_slot(src, me), pl.ds(t * br, rows)],
                            tmp_ref.at[pl.ds(0, rows)], copy_sem)
 
             if src == 0:

@@ -95,7 +95,8 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
     def _push_tile():
         send_tile[parity] = partial.astype(send_tile.dtype)
         common.remote_copy(
-            send_tile.at[parity], staging.at[me, :, pl.ds(j * bn, bn)],
+            send_tile.at[parity],
+            staging.at[common.peer_slot(me, dst), :, pl.ds(j * bn, bn)],
             send_sems.at[parity], recv_sems.at[me], axis, dst)
 
     # Own segment (last): fold the world-1 remote partials per tile, in a
@@ -108,7 +109,8 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
             for src in range(world):
                 @pl.when(src != me)
                 def _wait(src=src):
-                    common.wait_recv(staging.at[src], recv_sems.at[src])
+                    common.wait_recv(staging.at[common.peer_slot(src, me)],
+                                     recv_sems.at[src])
 
         acc_tile[...] = jnp.zeros_like(acc_tile)
         for src in range(world):
@@ -118,8 +120,10 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
 
             @pl.when(src != me)
             def _add_remote(src=src):
-                common.local_copy(staging.at[src, :, pl.ds(j * bn, bn)],
-                                  tmp_tile, copy_sem)
+                common.local_copy(
+                    staging.at[common.peer_slot(src, me), :,
+                               pl.ds(j * bn, bn)],
+                    tmp_tile, copy_sem)
                 acc_tile[...] += tmp_tile[...].astype(jnp.float32)
         out_tile[...] = acc_tile[...].astype(out_tile.dtype)
         common.local_copy(out_tile, o_ref.at[:, pl.ds(j * bn, bn)], copy_sem)
@@ -165,7 +169,7 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),              # (m, N)
         scratch_shapes=[
-            pltpu.HBM((world, m, n), out_dtype),      # incoming partials
+            pltpu.HBM((world - 1, m, n), out_dtype),  # incoming partials
             pltpu.VMEM((m, k_local), a_local.dtype),  # dst-segment A rows
             pltpu.VMEM((2, m, bn), out_dtype),        # per-tile send buffer
             pltpu.VMEM((m, bn), jnp.float32),         # own-tile accumulator

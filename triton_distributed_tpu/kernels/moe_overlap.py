@@ -1,4 +1,4 @@
-"""MoE-TP hybrid overlap ops: AG + GroupGEMM and GroupGEMM + topk-reduce-RS.
+"""MoE-TP hybrid overlap kernels: AG-GroupGEMM and GroupGEMM-topk-reduce-RS.
 
 TPU-native analogs of the reference's ``allgather_group_gemm.py`` (605 LoC:
 ``MoEAllGatherGroupGEMMTensorParallelContext`` :198, ``ag_group_gemm`` :398,
@@ -7,15 +7,29 @@ sorted gather index calc :83, block-aligned scheduling via the csrc
 (1432 LoC: rowise grouped-GEMM producer :380, topk-reduce + RS consumer
 :486/:564, ``moe_reduce_rs_rowise`` :816).
 
-TPU design: the communication legs are the Pallas overlap kernels from this
-package (ring/all2all allgather, ring reduce-scatter); the expert compute is
-a batched einsum the XLA scheduler fuses and overlaps with its neighbors'
-prologue/epilogue. Where the reference hand-schedules tile arrival order
-(threadblock_swizzle_ag_moe.cu) we rely on the capacity-grid routing from
-``moe_utils`` — static shapes, no alignment kernel needed. Fusing the
-grouped GEMM *into* the AG kernel (per-segment expert compute as shards
-arrive, like allgather_gemm.py) is the follow-up optimization; the API is
-already shaped for it.
+TPU design — the reference's dynamic tile alignment becomes a static
+capacity grid, and both ops are SINGLE Pallas kernels with comm overlapped
+into the grouped GEMM:
+
+- Each device pre-routes its local (token, k) pairs into an (E, cap, d)
+  per-expert capacity grid (``moe_utils.route_to_experts`` — plain jnp
+  argsort/scatter; the alignment-op analog). Empty slots are zero, so they
+  multiply through to zero rows — no masking inside the kernels.
+- ``ag_group_gemm_device``: the AG-GEMM structure (allgather_gemm.py:65)
+  with an expert dimension. At startup every device pushes its grid to all
+  peers (async ICI DMAs); the grid walks (segment, expert, f-tile) in
+  arrival-swizzled order, and the MXU computes each arrived source's
+  per-expert (cap, d) x (d, bf) tile while later segments are still in
+  flight. Output (E, world*cap, f_local) keeps per-source slot ranges, so
+  grouped-layout bookkeeping is implicit (slot (src, e, i) = row
+  src*cap + i of expert e).
+- ``group_gemm_rs_device``: the GEMM-RS structure (gemm_reduce_scatter.py)
+  with an expert dimension: destination segments first, each (dst, e,
+  d-tile) partial pushed to its owner the moment the MXU finishes it; the
+  own segment folds arrivals in fixed global rank order. Output (E, cap, d)
+  = this device's tokens' rows, fully reduced over the f shards.
+- ``ag_moe_mlp_device`` chains them: route -> AG-GroupGEMM(up) -> act ->
+  GroupGEMM-RS(down) -> local topk-combine.
 
 Sharding convention (EP within TP, reference test_ag_moe.py):
   tokens:   (M, d) sharded on M over ``axis``   -> per-device (m, d)
@@ -26,78 +40,298 @@ Sharding convention (EP within TP, reference test_ag_moe.py):
 
 from __future__ import annotations
 
+import dataclasses
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
-from triton_distributed_tpu.kernels.allgather import ring_all_gather
-from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_scatter
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.kernels import common
 from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.runtime.platform import resolve_interpret
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEOverlapConfig:
+    """Tile configuration (the analog of the reference context block sizes,
+    allgather_group_gemm.py:198)."""
+
+    block_f: int = 256   # f_local tiling in the up-projection kernel
+    block_d: int = 256   # d tiling in the down-projection RS kernel
+
+    @staticmethod
+    def tiles(dim: int, block: int) -> tuple[int, int]:
+        b = min(block, dim)
+        if dim % b:
+            raise ValueError(f"dim {dim} not divisible by block {b}")
+        return dim // b, b
+
+
+# ---------------------------------------------------------------------------
+# AG-GroupGEMM: allgather of capacity grids overlapped into per-expert GEMMs.
+# ---------------------------------------------------------------------------
+
+
+def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
+                          send_sems, recv_sems, copy_sem, *, axis: str,
+                          world: int, n_e: int, n_f: int):
+    s = pl.program_id(0)
+    e = pl.program_id(1)
+    j = pl.program_id(2)
+    me = me_ref[0]
+    src = jax.lax.rem(me + s, world)  # own grid first, then by distance
+
+    @pl.when((s == 0) & (e == 0) & (j == 0))
+    def _startup():
+        dl.barrier_all(axis)
+        common.local_copy(x_ref, a_full.at[me], copy_sem)
+        for i in range(world - 1):
+            peer = jax.lax.rem(me + 1 + i, world)
+            common.remote_copy(x_ref, a_full.at[me], send_sems.at[i],
+                               recv_sems.at[me], axis, peer)
+
+    @pl.when((e == 0) & (j == 0) & (s > 0))
+    def _arrive():
+        common.wait_recv(a_full.at[src], recv_sems.at[src])
+
+    @pl.when(j == 0)
+    def _load():
+        common.local_copy(a_full.at[src, e], a_vmem, copy_sem)  # (cap, d)
+
+    o_ref[0] = jnp.dot(a_vmem[...], w_ref[0],
+                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+    @pl.when((s == world - 1) & (e == n_e - 1) & (j == n_f - 1))
+    def _drain():
+        for i in range(world - 1):
+            common.wait_recv(x_ref, send_sems.at[i])
 
 
 def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
-                         n_experts: int, expert_capacity: int,
-                         axis: str = "tp", interpret=None):
-    """AG of sequence-sharded tokens + per-expert grouped GEMM.
+                         n_experts: int, capacity: int, axis: str = "tp",
+                         config: MoEOverlapConfig | None = None,
+                         interpret=None):
+    """AG of per-expert capacity grids + grouped GEMM in one kernel.
 
     x_local (m, d), topk_ids_local (m, k), w_up_local (E, d, f_local)
-    -> (grouped (E, expert_capacity, f_local), expert_counts, src_idx,
-    n_dropped): every device computes all experts over the *gathered* tokens
-    against its f-shard of each expert's weight (column-parallel MoE
-    up-projection, reference ``ag_group_gemm`` allgather_group_gemm.py:398).
-    ``n_dropped`` counts (token, k) pairs lost to ``expert_capacity``
-    overflow — observable, never silent (ADVICE r1).
-    """
-    x_full = ring_all_gather(x_local, axis=axis, interpret=interpret)
-    ids_full = ring_all_gather(topk_ids_local, axis=axis, interpret=interpret)
-    M, k = ids_full.shape
-    flat_ids = ids_full.reshape(M * k)
-    # Group (token, k) pairs by expert (the role of the csrc alignment op).
-    grouped, counts, src_idx, n_dropped = moe_utils.tokens_by_local_expert(
-        jnp.repeat(x_full, k, axis=0)[None],        # (1, M*k, d) capacity grid
-        flat_ids[None],
-        jnp.asarray([M * k], jnp.int32),
-        n_local_experts=n_experts, expert_base=0,
-        expert_capacity=expert_capacity)
-    out = moe_utils.grouped_gemm(grouped, w_up_local)
-    return out, counts, src_idx, n_dropped
+    -> (up (E, world*cap, f_local), state): every device computes all
+    experts over every source's grid against its f-shard of each expert's
+    weight (column-parallel MoE up-projection, reference ``ag_group_gemm``
+    allgather_group_gemm.py:398), with the allgather overlapped into the
+    expert GEMMs. ``state`` carries the local routing bookkeeping —
+    ``slot``/``kept`` for ``combine_from_experts`` (topk weights are passed
+    there directly), plus ``n_dropped``: capacity overflow is observable,
+    never silent (ADVICE r1)."""
+    config = config or MoEOverlapConfig()
+    world = jax.lax.axis_size(axis)
+    m, d = x_local.shape
+    E, _, f_local = w_up_local.shape
+    if E != n_experts:
+        raise ValueError(f"w_up has {E} experts, expected {n_experts}")
+
+    grid_x, slot, kept, n_dropped = moe_utils.route_to_experts(
+        x_local, topk_ids_local, n_experts=n_experts, capacity=capacity)
+    state = {"slot": slot, "kept": kept, "n_dropped": n_dropped}
+
+    n_f, bf = MoEOverlapConfig.tiles(f_local, config.block_f)
+    out_dtype = jnp.promote_types(x_local.dtype, w_up_local.dtype)
+
+    if world == 1:
+        up = moe_utils.grouped_gemm(grid_x, w_up_local)
+        return up.astype(out_dtype), state
+
+    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, E, n_f),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),                # local grid
+            pl.BlockSpec((1, d, bf), lambda s, e, j, me_ref: (e, 0, j)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, capacity, bf),
+            lambda s, e, j, me_ref: (e, jax.lax.rem(me_ref[0] + s, world), j),
+        ),
+        scratch_shapes=[
+            pltpu.HBM((world, E, capacity, d), x_local.dtype),
+            pltpu.VMEM((capacity, d), x_local.dtype),
+            common.dma_sems(world - 1),
+            common.dma_sems(world),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    up = pl.pallas_call(
+        functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
+                          n_e=E, n_f=n_f),
+        out_shape=jax.ShapeDtypeStruct((E, world * capacity, f_local),
+                                       out_dtype),
+        grid_spec=grid_spec,
+        compiler_params=common.compiler_params(
+            common.collective_id_for("ag_group_gemm")),
+        interpret=resolve_interpret(interpret),
+    )(me, grid_x, w_up_local)
+    return up, state
 
 
-def moe_reduce_rs_device(expert_out, src_idx, topk_weights_full, w_down_local,
-                         *, n_tokens: int, topk: int, axis: str = "tp",
+# ---------------------------------------------------------------------------
+# GroupGEMM-reduce-RS: per-expert down-projection with each (dst, e, d-tile)
+# partial pushed to its owner as computed; owner folds + keeps its cap rows.
+# ---------------------------------------------------------------------------
+
+
+def _group_gemm_rs_kernel(me_ref, a_ref, w_ref, o_ref, staging, a_vmem,
+                          send_tile, acc_tile, tmp_tile, out_tile, send_sems,
+                          recv_sems, copy_sem, *, axis: str, world: int,
+                          n_e: int, n_d: int, bd: int, cap: int):
+    s = pl.program_id(0)
+    e = pl.program_id(1)
+    j = pl.program_id(2)
+    me = me_ref[0]
+    dst = jax.lax.rem(me + 1 + s, world)  # remote destinations first
+    is_own = s == world - 1
+    t = (s * n_e + e) * n_d + j           # global tile counter (remote first)
+    parity = jax.lax.rem(t, 2)
+    total_remote = (world - 1) * n_e * n_d
+
+    @pl.when((s == 0) & (e == 0) & (j == 0))
+    def _startup():
+        dl.barrier_all(axis)
+
+    # Load destination dst's rows of expert e once per (s, e).
+    @pl.when(j == 0)
+    def _load():
+        common.local_copy(a_ref.at[e, pl.ds(dst * cap, cap)], a_vmem,
+                          copy_sem)
+
+    @pl.when(~is_own & (t >= 2))
+    def _reclaim():
+        common.wait_recv(send_tile.at[parity], send_sems.at[parity])
+
+    partial = jnp.dot(a_vmem[...], w_ref[0],
+                      preferred_element_type=jnp.float32)   # (cap, bd)
+
+    @pl.when(~is_own)
+    def _push_tile():
+        send_tile[parity] = partial.astype(send_tile.dtype)
+        common.remote_copy(
+            send_tile.at[parity],
+            staging.at[common.peer_slot(me, dst), e, :, pl.ds(j * bd, bd)],
+            send_sems.at[parity], recv_sems.at[me], axis, dst)
+
+    @pl.when(is_own)
+    def _own_segment():
+        @pl.when((e == 0) & (j == 0))
+        def _arrivals():
+            for src in range(world):
+                @pl.when(src != me)
+                def _wait(src=src):
+                    common.wait_recv(staging.at[common.peer_slot(src, me)],
+                                     recv_sems.at[src])
+
+        acc_tile[...] = jnp.zeros_like(acc_tile)
+        for src in range(world):          # fixed global order (ADVICE r1)
+            @pl.when(src == me)
+            def _add_own():
+                acc_tile[...] += partial
+
+            @pl.when(src != me)
+            def _add_remote(src=src):
+                common.local_copy(
+                    staging.at[common.peer_slot(src, me), e, :,
+                               pl.ds(j * bd, bd)],
+                    tmp_tile, copy_sem)
+                acc_tile[...] += tmp_tile[...].astype(jnp.float32)
+        out_tile[...] = acc_tile[...].astype(out_tile.dtype)
+        common.local_copy(out_tile, o_ref.at[e, :, pl.ds(j * bd, bd)],
+                          copy_sem)
+
+        @pl.when((e == n_e - 1) & (j == n_d - 1))
+        def _drain():
+            for p in range(min(2, total_remote)):
+                common.wait_recv(send_tile.at[p], send_sems.at[p])
+
+
+def group_gemm_rs_device(act, w_down_local, *, capacity: int,
+                         axis: str = "tp",
+                         config: MoEOverlapConfig | None = None,
                          interpret=None):
-    """Grouped down-projection + topk-weighted reduce + reduce-scatter.
+    """Grouped down-projection fused with the reduce-scatter over f shards.
 
-    expert_out (E, cap_e, f_local), src_idx from ``ag_group_gemm_device``,
-    topk_weights_full (M, k) replicated, w_down_local (E, f_local, d)
-    -> (m, d) M-shard of the topk-combined output, summed over the f shards
-    via ring reduce-scatter (reference ``moe_reduce_rs_rowise``,
-    moe_reduce_rs.py:816)."""
-    down = moe_utils.grouped_gemm(expert_out, w_down_local)  # (E, cap_e, d)
-    flat = moe_utils.scatter_back_from_experts(
-        down, src_idx, world=1, capacity=n_tokens * topk)
-    per_pair = flat.reshape(n_tokens * topk, -1)
-    weighted = per_pair * topk_weights_full.reshape(-1, 1).astype(per_pair.dtype)
-    combined = weighted.reshape(n_tokens, topk, -1).sum(axis=1)  # (M, d) partial
-    return ring_reduce_scatter(combined, axis=axis, interpret=interpret)
+    act (E, world*cap, f_local) — ``ag_group_gemm_device`` output layout;
+    w_down_local (E, f_local, d). Returns (E, cap, d): this device's own
+    cap rows per expert, summed over every rank's f-shard partial
+    (reference ``moe_reduce_rs_rowise``, moe_reduce_rs.py:816), comm
+    overlapped into the expert GEMMs."""
+    config = config or MoEOverlapConfig()
+    world = jax.lax.axis_size(axis)
+    E, rows, f_local = act.shape
+    _, _, d = w_down_local.shape
+    if rows != world * capacity:
+        raise ValueError(f"act rows {rows} != world*capacity {world * capacity}")
+    n_d, bd = MoEOverlapConfig.tiles(d, config.block_d)
+    out_dtype = jnp.promote_types(act.dtype, w_down_local.dtype)
+
+    if world == 1:
+        return moe_utils.grouped_gemm(act, w_down_local).astype(out_dtype)
+
+    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(world, E, n_d),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),               # act
+            pl.BlockSpec((1, f_local, bd), lambda s, e, j, me_ref: (e, 0, j)),
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),         # (E, cap, d)
+        scratch_shapes=[
+            pltpu.HBM((world - 1, E, capacity, d), out_dtype),  # partials
+            pltpu.VMEM((capacity, f_local), act.dtype),      # dst rows
+            pltpu.VMEM((2, capacity, bd), out_dtype),        # send buffer
+            pltpu.VMEM((capacity, bd), jnp.float32),         # accumulator
+            pltpu.VMEM((capacity, bd), out_dtype),           # remote tile
+            pltpu.VMEM((capacity, bd), out_dtype),           # cast-out tile
+            common.dma_sems(2),
+            common.dma_sems(world),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_group_gemm_rs_kernel, axis=axis, world=world,
+                          n_e=E, n_d=n_d, bd=bd, cap=capacity),
+        out_shape=jax.ShapeDtypeStruct((E, capacity, d), out_dtype),
+        grid_spec=grid_spec,
+        compiler_params=common.compiler_params(
+            common.collective_id_for("moe_reduce_rs")),
+        interpret=resolve_interpret(interpret),
+    )(me, act, w_down_local)
+
+
+# ---------------------------------------------------------------------------
+# Full MoE-TP MLP pipeline
+# ---------------------------------------------------------------------------
 
 
 def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
-                      w_down_local, *, n_experts: int, expert_capacity: int,
+                      w_down_local, *, n_experts: int, capacity: int,
                       activation=jax.nn.silu, axis: str = "tp",
-                      interpret=None):
-    """Full MoE-TP MLP: AG -> GroupGEMM(up) -> act -> GroupGEMM(down) ->
-    topk-reduce -> RS (the reference's "AG MoE" tutorial pipeline).
-    Returns (out (m, d), n_dropped): capacity overflow zeroes the dropped
-    pairs' contribution but is observable, never silent (ADVICE r1)."""
-    up, counts, src_idx, n_dropped = ag_group_gemm_device(
+                      config: MoEOverlapConfig | None = None, interpret=None):
+    """Full MoE-TP MLP: route -> AG-GroupGEMM(up) -> act -> GroupGEMM-RS
+    (down) -> local topk-combine (the reference's "AG MoE" pipeline).
+    ``capacity`` bounds tokens per (source device, expert); m*k covers the
+    worst case. Returns (out (m, d), n_dropped) — overflow zeroes the
+    dropped pairs' contribution but is observable, never silent (ADVICE
+    r1)."""
+    up, state = ag_group_gemm_device(
         x_local, topk_ids_local, w_up_local, n_experts=n_experts,
-        expert_capacity=expert_capacity, axis=axis, interpret=interpret)
+        capacity=capacity, axis=axis, config=config, interpret=interpret)
     act = activation(up.astype(jnp.float32)).astype(up.dtype)
-    w_full = ring_all_gather(topk_weights_local, axis=axis,
-                             interpret=interpret)
-    m, k = topk_ids_local.shape
-    world = jax.lax.axis_size(axis)
-    out = moe_reduce_rs_device(
-        act, src_idx, w_full, w_down_local, n_tokens=world * m, topk=k,
-        axis=axis, interpret=interpret)
-    return out, n_dropped
+    down = group_gemm_rs_device(
+        act, w_down_local, capacity=capacity, axis=axis, config=config,
+        interpret=interpret)                                # (E, cap, d)
+    out = moe_utils.combine_from_experts(
+        down, topk_ids_local, topk_weights_local, state["slot"],
+        state["kept"])
+    return out, state["n_dropped"]

@@ -150,6 +150,48 @@ def scatter_back_from_experts(expert_out, src_flat_idx, *, world: int,
     return flat_out.reshape(world, capacity, hidden)
 
 
+def route_to_experts(x, topk_ids, *, n_experts: int, capacity: int):
+    """Pack this device's (token, k) pairs into a per-expert capacity grid —
+    the local pre-sort that replaces the reference's CUDA alignment op
+    (csrc/lib/moe_utils.cu ``moe_ag_scatter_align_block_size``): static
+    shapes mean the grouped GEMM sees one dense (capacity, d) tile per
+    expert, and the AG-GroupGEMM kernel can push/compute whole grids.
+
+    x: (n, d); topk_ids: (n, k). Returns (grid (E, capacity, d) — empty
+    slots zero, slot (n, k) — each pair's slot in its expert's block,
+    kept (n, k) bool, n_dropped () int32)."""
+    n, k = topk_ids.shape
+    flat = topk_ids.reshape(-1)
+    order = jnp.argsort(flat, stable=True)
+    sorted_e = flat[order]
+    counts = jnp.bincount(sorted_e, length=n_experts)
+    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)[:-1]])
+    slot_sorted = jnp.arange(n * k) - starts[sorted_e]
+    kept_sorted = slot_sorted < capacity
+    e_idx = jnp.where(kept_sorted, sorted_e, n_experts)   # OOB -> dropped
+    rows = jnp.repeat(x, k, axis=0)[order]
+    grid = jnp.zeros((n_experts, capacity, x.shape[-1]), x.dtype)
+    grid = grid.at[e_idx, jnp.where(kept_sorted, slot_sorted, 0)].set(
+        rows, mode="drop")
+    # Un-sort the (slot, kept) bookkeeping back to (n, k) order.
+    slot = jnp.zeros((n * k,), jnp.int32).at[order].set(
+        slot_sorted.astype(jnp.int32))
+    kept = jnp.zeros((n * k,), bool).at[order].set(kept_sorted)
+    return (grid, slot.reshape(n, k), kept.reshape(n, k),
+            jnp.sum(~kept_sorted).astype(jnp.int32))
+
+
+def combine_from_experts(out_grid, topk_ids, topk_weights, slot, kept):
+    """Inverse of ``route_to_experts`` after expert compute: gather each
+    pair's row from the reduced (E, capacity, d) grid, weight by topk
+    probability, sum the k duplicates (the reference's ``reduce_topk``)."""
+    rows = out_grid[topk_ids, slot]                       # (n, k, d)
+    rows = jnp.where(kept[..., None], rows, 0)
+    w = topk_weights[..., None].astype(rows.dtype)
+    return jnp.sum(rows * w, axis=1)
+
+
 def grouped_gemm(grouped, weights):
     """Batched per-expert matmul: (E, cap_e, d) x (E, d, f) -> (E, cap_e, f).
     Plain einsum — XLA batches it onto the MXU; a Pallas megablox-style

@@ -50,19 +50,21 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
 
     dl.barrier_all(axis)
 
-    # Push chunk x[peer] into peer's staging slot ``me``.
+    # Push chunk x[peer] into peer's staging slot for source ``me``.
     sends = []
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
         dma = common.remote_copy(
-            x_ref.at[pl.ds(peer * m, m)], staging.at[me],
+            x_ref.at[pl.ds(peer * m, m)],
+            staging.at[common.peer_slot(me, peer)],
             send_sems.at[i], recv_sems.at[me], axis, peer)
         sends.append(dma)
 
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
-            common.wait_recv(staging.at[src], recv_sems.at[src])
+            common.wait_recv(staging.at[common.peer_slot(src, me)],
+                             recv_sems.at[src])
 
     # Fixed global reduce order 0..world-1 (own chunk read straight from
     # x_ref): deterministic, rank-independent bits (ADVICE r1); row-tiled.
@@ -130,9 +132,8 @@ def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
     rest = x_local.shape[1:]
     br = common.stage_row_tile(m, rest, x_local.dtype.itemsize)
     oneshot = n_staging_key == "oneshot"
-    n_staging = world if oneshot else world - 1
     scratch = [
-        pltpu.HBM((n_staging, m, *rest), x_local.dtype),   # staging
+        pltpu.HBM((world - 1, m, *rest), x_local.dtype),   # remote arrivals
     ]
     if not oneshot:
         scratch.append(pltpu.HBM((m, *rest), x_local.dtype))  # ring send
