@@ -18,6 +18,14 @@ from triton_distributed_tpu.kernels.allreduce import (  # noqa: F401
     oneshot_all_reduce,
     twoshot_all_reduce,
 )
+from triton_distributed_tpu.kernels.collective_2d import (  # noqa: F401
+    all_gather_2d,
+    all_gather_2d_device,
+    all_reduce_2d,
+    all_reduce_2d_device,
+    reduce_scatter_2d,
+    reduce_scatter_2d_device,
+)
 from triton_distributed_tpu.kernels.allgather_gemm import (  # noqa: F401
     AGGEMMConfig,
     ag_gemm,

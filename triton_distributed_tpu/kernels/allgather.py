@@ -45,18 +45,24 @@ from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
 class AllGatherMethod(enum.Enum):
-    """Reference parity: allgather.py:46. 2D variants pending multi-slice."""
+    """Reference parity: allgather.py:46 (Auto/All2All/Ring1D + the 2D
+    inter-node variant; NUMA-2D has no TPU analog — ICI is symmetric)."""
 
     AUTO = "auto"
     ALL2ALL = "all2all"
     RING_1D = "ring_1d"
+    RING_2D = "ring_2d"   # intra-slice ring + DCN leg (collective_2d.py)
 
 
-def choose_all_gather_method(world: int, nbytes: int) -> AllGatherMethod:
+def choose_all_gather_method(world: int, nbytes: int,
+                             num_slices: int = 1) -> AllGatherMethod:
     """Latency/bandwidth heuristic (analog of ``get_auto_all_gather_method``,
-    allgather.py:57): small messages prefer direct pushes (one hop count,
-    world-1 concurrent DMAs), large messages prefer the ring (each ICI link
-    carries each byte once)."""
+    allgather.py:57): a DCN-spanning mesh must go hierarchical (2D); small
+    messages prefer direct pushes (one hop count, world-1 concurrent DMAs),
+    large messages prefer the ring (each ICI link carries each byte once).
+    ``num_slices`` comes from ``Topology.num_slices`` (runtime/mesh.py)."""
+    if num_slices > 1:
+        return AllGatherMethod.RING_2D
     if world <= 2:
         return AllGatherMethod.ALL2ALL
     return AllGatherMethod.ALL2ALL if nbytes <= (1 << 20) else AllGatherMethod.RING_1D
@@ -171,19 +177,35 @@ def a2a_all_gather(x_local, *, axis: str = "tp", interpret=None):
 
 def all_gather(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
                method: AllGatherMethod | str = AllGatherMethod.AUTO,
-               interpret=None):
+               dcn_axis: str | None = None, interpret=None):
     """Standalone allgather over a mesh axis.
 
     ``x_stacked``: global ``(world, *local)`` array, device ``r`` owning slice
     ``[r]`` (the symmetric-workspace convention). Returns the gathered
     ``(world * local[0], *local[1:])`` array (replicated).
+
+    Pass ``dcn_axis`` on a multi-slice ``(dcn, ici)`` mesh (see
+    ``runtime.mesh.make_2d_mesh``): AUTO then dispatches to the hierarchical
+    2D method, with ``axis`` as the intra-slice (ICI) axis. On that path the
+    stacked leading dim is the TOTAL device count
+    ``mesh.shape[dcn_axis] * mesh.shape[axis]`` (dcn-major rank order).
     """
     mesh = mesh or get_default_mesh()
     world = mesh.shape[axis]
     if isinstance(method, str):
         method = AllGatherMethod(method)
     if method is AllGatherMethod.AUTO:
-        method = choose_all_gather_method(world, x_stacked.nbytes // world)
+        num_slices = mesh.shape.get(dcn_axis, 1) if dcn_axis else 1
+        method = choose_all_gather_method(world, x_stacked.nbytes // world,
+                                          num_slices)
+    if method is AllGatherMethod.RING_2D:
+        if dcn_axis is None:
+            raise ValueError("method ring_2d needs dcn_axis (a (dcn, ici) "
+                             "mesh; see runtime.mesh.make_2d_mesh)")
+        from triton_distributed_tpu.kernels.collective_2d import all_gather_2d
+
+        return all_gather_2d(x_stacked, mesh=mesh, ici_axis=axis,
+                             dcn_axis=dcn_axis, interpret=interpret)
     return _build_ag(mesh, axis, method, interpret, x_stacked.ndim - 1)(x_stacked)
 
 

@@ -1,0 +1,158 @@
+"""Hierarchical 2D collectives: intra-slice ICI ring + inter-slice DCN leg.
+
+TPU-native analog of the reference's inter-node ("inter_node" scope) paths:
+the NVSHMEM put allgather kernels (``kernels/nvidia/allgather.py:379-554``),
+the 2D reduce-scatter (``reduce_scatter.py:45`` ``ReduceScatter2DContext``:
+intra-node scatter -> local reduce -> inter-node p2p of same-local-rank
+segments), and the 2D/NUMA ring methods of ``AllGatherMethod``.
+
+TPU design (SURVEY.md §5 backend mapping, §7 hard-part 6): ICI exposes
+device-initiated one-sided remote DMA, DCN does NOT — there is no
+device-initiated put across slices. So the intra-slice leg is this
+package's Pallas ring/push kernels (semaphore-signalled ICI DMA), and the
+inter-slice leg rides XLA's DCN collectives (``jax.lax.all_gather`` /
+``psum_scatter`` / ``psum``), exactly mirroring the reference's split
+between copy-engine/NVLink kernels intra-node and NVSHMEM transports
+inter-node. XLA overlaps the DCN transfer with neighbouring compute via its
+async collective scheduling — the role of the reference's separate
+inter-node streams.
+
+Rank convention (matches ``shard_map`` over a ``(dcn, ici)`` mesh and the
+stacked host wrappers): global rank = dcn_index * w_ici + ici_index
+(dcn-major).
+
+Per-device forms compose inside ``shard_map`` over BOTH axes; host wrappers
+take the stacked ``(world, ...)`` convention of the 1D collectives.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from triton_distributed_tpu.kernels.allgather import ring_all_gather
+from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_scatter
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+def all_gather_2d_device(x_local, *, ici_axis: str = "ici",
+                         dcn_axis: str = "dcn", interpret=None):
+    """Per-device 2D allgather: ``(m, ...)`` -> ``(W*m, ...)`` with segments
+    in dcn-major global rank order. Intra-slice Pallas ring first (each DCN
+    link then carries each slice's block exactly once), then the DCN leg.
+
+    Reference analog: ``cp_engine_producer_all_gather_inter_node``
+    (allgather.py:554) — intra-node CE ring + NVSHMEM inter-node put."""
+    intra = ring_all_gather(x_local, axis=ici_axis, interpret=interpret)
+    return jax.lax.all_gather(intra, dcn_axis, axis=0, tiled=True)
+
+
+def reduce_scatter_2d_device(x_local, *, ici_axis: str = "ici",
+                             dcn_axis: str = "dcn", interpret=None):
+    """Per-device 2D reduce-scatter: ``(W*m, ...)`` (this device's full
+    contribution) -> ``(m, ...)`` = sum over all W devices of this device's
+    dcn-major global segment.
+
+    Structure (reference ``ReduceScatter2DContext`` reduce_scatter.py:45,
+    inverted for push-efficiency): regroup rows so each ICI rank's chunk
+    holds every slice's rows for that rank, ring-reduce-scatter them over
+    ICI (Pallas), then ``psum_scatter`` the surviving ``w_dcn`` segments
+    over DCN. Each ICI link carries each byte once; DCN carries only the
+    already slice-reduced chunk."""
+    w_ici = jax.lax.axis_size(ici_axis)
+    w_dcn = jax.lax.axis_size(dcn_axis)
+    rows = x_local.shape[0]
+    if rows % (w_ici * w_dcn):
+        raise ValueError(f"leading dim {rows} not divisible by world "
+                         f"{w_ici * w_dcn}")
+    m = rows // (w_ici * w_dcn)
+    # (dcn, ici, m, ...) -> (ici, dcn, m, ...): the ICI ring's chunk i then
+    # holds the rows of every global rank (d, i).
+    xt = x_local.reshape(w_dcn, w_ici, m, *x_local.shape[1:])
+    xt = jnp.swapaxes(xt, 0, 1).reshape(w_ici * w_dcn * m,
+                                        *x_local.shape[1:])
+    intra = ring_reduce_scatter(xt, axis=ici_axis, interpret=interpret)
+    return jax.lax.psum_scatter(intra, dcn_axis, scatter_dimension=0,
+                                tiled=True)
+
+
+def all_reduce_2d_device(x_local, *, ici_axis: str = "ici",
+                         dcn_axis: str = "dcn", interpret=None):
+    """Per-device 2D allreduce: ring-RS over ICI, ``psum`` of the surviving
+    chunk over DCN (only 1/w_ici of the bytes cross the slow DCN hop), then
+    ring-AG over ICI — the hierarchical two-shot (reference
+    ``allreduce.py`` two-shot generalized to the 2D topology)."""
+    w_ici = jax.lax.axis_size(ici_axis)
+    if x_local.shape[0] % w_ici:
+        raise ValueError(
+            f"2D allreduce needs leading dim {x_local.shape[0]} divisible by "
+            f"the ici world {w_ici}; pad or use the 1D one-shot")
+    chunk = ring_reduce_scatter(x_local, axis=ici_axis, interpret=interpret)
+    chunk = jax.lax.psum(chunk, dcn_axis)
+    return ring_all_gather(chunk, axis=ici_axis, interpret=interpret)
+
+
+# ---------------------------------------------------------------------------
+# Host-level wrappers (stacked convention, tests / standalone use)
+# ---------------------------------------------------------------------------
+
+
+def _2d_wrapper(per_device, out_stacked: bool):
+    @functools.lru_cache(maxsize=None)
+    def build(mesh, ici_axis, dcn_axis, interpret, nd):
+        def f(xs):
+            y = per_device(xs[0], ici_axis=ici_axis, dcn_axis=dcn_axis,
+                           interpret=interpret)
+            return y[None] if out_stacked else y
+
+        rest = [None] * nd
+        out_spec = (P((dcn_axis, ici_axis), *rest) if out_stacked
+                    else P(*rest))
+        return jax.jit(jax.shard_map(
+            f, mesh=mesh,
+            in_specs=P((dcn_axis, ici_axis), *rest),
+            out_specs=out_spec,
+            check_vma=False,
+        ))
+
+    return build
+
+
+_build_ag2d = _2d_wrapper(all_gather_2d_device, out_stacked=False)
+_build_rs2d = _2d_wrapper(reduce_scatter_2d_device, out_stacked=True)
+_build_ar2d = _2d_wrapper(all_reduce_2d_device, out_stacked=False)
+
+
+def all_gather_2d(x_stacked, *, mesh: Mesh | None = None,
+                  ici_axis: str = "ici", dcn_axis: str = "dcn",
+                  interpret=None):
+    """Stacked-convention 2D allgather: ``(W, *local)`` (device r owns
+    ``[r]``, dcn-major) -> gathered ``(W*local[0], ...)`` replicated."""
+    mesh = mesh or get_default_mesh()
+    return _build_ag2d(mesh, ici_axis, dcn_axis, interpret,
+                       x_stacked.ndim - 1)(x_stacked)
+
+
+def reduce_scatter_2d(x_stacked, *, mesh: Mesh | None = None,
+                      ici_axis: str = "ici", dcn_axis: str = "dcn",
+                      interpret=None):
+    """Stacked-convention 2D reduce-scatter: ``(W, W*m, ...)`` ->
+    ``(W*m, ...)`` sharded so global rank r owns segment r (= sum over
+    devices of their segment r)."""
+    mesh = mesh or get_default_mesh()
+    return _build_rs2d(mesh, ici_axis, dcn_axis, interpret,
+                       x_stacked.ndim - 1)(x_stacked).reshape(
+                           x_stacked.shape[1:])
+
+
+def all_reduce_2d(x_stacked, *, mesh: Mesh | None = None,
+                  ici_axis: str = "ici", dcn_axis: str = "dcn",
+                  interpret=None):
+    """Stacked-convention 2D allreduce: ``(W, m, ...)`` -> reduced
+    ``(m, ...)`` replicated."""
+    mesh = mesh or get_default_mesh()
+    return _build_ar2d(mesh, ici_axis, dcn_axis, interpret,
+                       x_stacked.ndim - 1)(x_stacked)

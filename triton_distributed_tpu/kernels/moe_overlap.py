@@ -57,10 +57,14 @@ from triton_distributed_tpu.runtime.platform import resolve_interpret
 @dataclasses.dataclass(frozen=True)
 class MoEOverlapConfig:
     """Tile configuration (the analog of the reference context block sizes,
-    allgather_group_gemm.py:198)."""
+    allgather_group_gemm.py:198). The contraction dims are tiled too
+    (``block_k``) so VMEM scales with blocks, not with d/f_local — full-
+    contraction VMEM blew the scoped budget at production shapes (r2
+    review)."""
 
     block_f: int = 256   # f_local tiling in the up-projection kernel
     block_d: int = 256   # d tiling in the down-projection RS kernel
+    block_k: int = 512   # contraction tiling (d in up, f_local in down)
 
     @staticmethod
     def tiles(dim: int, block: int) -> tuple[int, int]:
@@ -76,35 +80,55 @@ class MoEOverlapConfig:
 
 
 def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
-                          send_sems, recv_sems, copy_sem, *, axis: str,
-                          world: int, n_e: int, n_f: int):
+                          acc_ref, send_sems, recv_sems, copy_sem, *,
+                          axis: str, world: int, n_e: int, n_f: int,
+                          n_k: int, bk: int, cap: int):
     s = pl.program_id(0)
     e = pl.program_id(1)
     j = pl.program_id(2)
+    kk = pl.program_id(3)
     me = me_ref[0]
     src = jax.lax.rem(me + s, world)  # own grid first, then by distance
 
-    @pl.when((s == 0) & (e == 0) & (j == 0))
+    @pl.when((s == 0) & (e == 0) & (j == 0) & (kk == 0))
     def _startup():
         dl.barrier_all(axis)
-        common.local_copy(x_ref, a_full.at[me], copy_sem)
         for i in range(world - 1):
             peer = jax.lax.rem(me + 1 + i, world)
-            common.remote_copy(x_ref, a_full.at[me], send_sems.at[i],
-                               recv_sems.at[me], axis, peer)
+            common.remote_copy(x_ref, a_full.at[common.peer_slot(me, peer)],
+                               send_sems.at[i], recv_sems.at[me], axis, peer)
 
-    @pl.when((e == 0) & (j == 0) & (s > 0))
+    @pl.when((e == 0) & (j == 0) & (kk == 0) & (s > 0))
     def _arrive():
-        common.wait_recv(a_full.at[src], recv_sems.at[src])
+        common.wait_recv(a_full.at[common.peer_slot(src, me)],
+                         recv_sems.at[src])
 
-    @pl.when(j == 0)
-    def _load():
-        common.local_copy(a_full.at[src, e], a_vmem, copy_sem)  # (cap, d)
+    # (cap, bk) contraction tile: own grid reads straight from x_ref (no
+    # staging round-trip; a_full holds only the world-1 remote arrivals).
+    ks = pl.ds(kk * bk, bk)
 
-    o_ref[0] = jnp.dot(a_vmem[...], w_ref[0],
-                       preferred_element_type=jnp.float32).astype(o_ref.dtype)
+    @pl.when(s == 0)
+    def _load_own():
+        common.local_copy(x_ref.at[e, :, ks], a_vmem, copy_sem)
 
-    @pl.when((s == world - 1) & (e == n_e - 1) & (j == n_f - 1))
+    @pl.when(s > 0)
+    def _load_remote():
+        common.local_copy(a_full.at[common.peer_slot(src, me), e, :, ks],
+                          a_vmem, copy_sem)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jnp.dot(a_vmem[...], w_ref[0],
+                            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == n_k - 1)
+    def _store():
+        o_ref[0] = acc_ref[...].astype(o_ref.dtype)
+
+    @pl.when((s == world - 1) & (e == n_e - 1) & (j == n_f - 1)
+             & (kk == n_k - 1))
     def _drain():
         for i in range(world - 1):
             common.wait_recv(x_ref, send_sems.at[i])
@@ -137,27 +161,31 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     state = {"slot": slot, "kept": kept, "n_dropped": n_dropped}
 
     n_f, bf = MoEOverlapConfig.tiles(f_local, config.block_f)
+    n_k, bk = MoEOverlapConfig.tiles(d, config.block_k)
     out_dtype = jnp.promote_types(x_local.dtype, w_up_local.dtype)
 
     if world == 1:
-        up = moe_utils.grouped_gemm(grid_x, w_up_local)
+        up = jnp.einsum("ecd,edf->ecf", grid_x, w_up_local,
+                        preferred_element_type=jnp.float32)
         return up.astype(out_dtype), state
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(world, E, n_f),
+        grid=(world, E, n_f, n_k),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),                # local grid
-            pl.BlockSpec((1, d, bf), lambda s, e, j, me_ref: (e, 0, j)),
+            pl.BlockSpec((1, bk, bf), lambda s, e, j, kk, me_ref: (e, kk, j)),
         ],
         out_specs=pl.BlockSpec(
             (1, capacity, bf),
-            lambda s, e, j, me_ref: (e, jax.lax.rem(me_ref[0] + s, world), j),
+            lambda s, e, j, kk, me_ref:
+                (e, jax.lax.rem(me_ref[0] + s, world), j),
         ),
         scratch_shapes=[
-            pltpu.HBM((world, E, capacity, d), x_local.dtype),
-            pltpu.VMEM((capacity, d), x_local.dtype),
+            pltpu.HBM((world - 1, E, capacity, d), x_local.dtype),
+            pltpu.VMEM((capacity, bk), x_local.dtype),
+            pltpu.VMEM((capacity, bf), jnp.float32),
             common.dma_sems(world - 1),
             common.dma_sems(world),
             pltpu.SemaphoreType.DMA(()),
@@ -165,7 +193,7 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     )
     up = pl.pallas_call(
         functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
-                          n_e=E, n_f=n_f),
+                          n_e=E, n_f=n_f, n_k=n_k, bk=bk, cap=capacity),
         out_shape=jax.ShapeDtypeStruct((E, world * capacity, f_local),
                                        out_dtype),
         grid_spec=grid_spec,
@@ -183,45 +211,51 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
 
 
 def _group_gemm_rs_kernel(me_ref, a_ref, w_ref, o_ref, staging, a_vmem,
-                          send_tile, acc_tile, tmp_tile, out_tile, send_sems,
-                          recv_sems, copy_sem, *, axis: str, world: int,
-                          n_e: int, n_d: int, bd: int, cap: int):
+                          send_tile, part_ref, acc_tile, tmp_tile, out_tile,
+                          send_sems, recv_sems, copy_sem, *, axis: str,
+                          world: int, n_e: int, n_d: int, n_k: int, bd: int,
+                          bk: int, cap: int):
     s = pl.program_id(0)
     e = pl.program_id(1)
     j = pl.program_id(2)
+    kk = pl.program_id(3)
     me = me_ref[0]
     dst = jax.lax.rem(me + 1 + s, world)  # remote destinations first
     is_own = s == world - 1
+    is_last_k = kk == n_k - 1
     t = (s * n_e + e) * n_d + j           # global tile counter (remote first)
     parity = jax.lax.rem(t, 2)
     total_remote = (world - 1) * n_e * n_d
 
-    @pl.when((s == 0) & (e == 0) & (j == 0))
+    @pl.when((s == 0) & (e == 0) & (j == 0) & (kk == 0))
     def _startup():
         dl.barrier_all(axis)
 
-    # Load destination dst's rows of expert e once per (s, e).
-    @pl.when(j == 0)
-    def _load():
-        common.local_copy(a_ref.at[e, pl.ds(dst * cap, cap)], a_vmem,
-                          copy_sem)
+    # Load destination dst's rows of expert e, contraction tile kk.
+    common.local_copy(
+        a_ref.at[e, pl.ds(dst * cap, cap), pl.ds(kk * bk, bk)], a_vmem,
+        copy_sem)
 
-    @pl.when(~is_own & (t >= 2))
+    @pl.when(kk == 0)
+    def _zero():
+        part_ref[...] = jnp.zeros_like(part_ref)
+
+    part_ref[...] += jnp.dot(a_vmem[...], w_ref[0],
+                             preferred_element_type=jnp.float32)  # (cap, bd)
+
+    @pl.when(~is_own & is_last_k & (t >= 2))
     def _reclaim():
         common.wait_recv(send_tile.at[parity], send_sems.at[parity])
 
-    partial = jnp.dot(a_vmem[...], w_ref[0],
-                      preferred_element_type=jnp.float32)   # (cap, bd)
-
-    @pl.when(~is_own)
+    @pl.when(~is_own & is_last_k)
     def _push_tile():
-        send_tile[parity] = partial.astype(send_tile.dtype)
+        send_tile[parity] = part_ref[...].astype(send_tile.dtype)
         common.remote_copy(
             send_tile.at[parity],
             staging.at[common.peer_slot(me, dst), e, :, pl.ds(j * bd, bd)],
             send_sems.at[parity], recv_sems.at[me], axis, dst)
 
-    @pl.when(is_own)
+    @pl.when(is_own & is_last_k)
     def _own_segment():
         @pl.when((e == 0) & (j == 0))
         def _arrivals():
@@ -235,7 +269,7 @@ def _group_gemm_rs_kernel(me_ref, a_ref, w_ref, o_ref, staging, a_vmem,
         for src in range(world):          # fixed global order (ADVICE r1)
             @pl.when(src == me)
             def _add_own():
-                acc_tile[...] += partial
+                acc_tile[...] += part_ref[...]
 
             @pl.when(src != me)
             def _add_remote(src=src):
@@ -272,25 +306,28 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
     if rows != world * capacity:
         raise ValueError(f"act rows {rows} != world*capacity {world * capacity}")
     n_d, bd = MoEOverlapConfig.tiles(d, config.block_d)
+    n_k, bk = MoEOverlapConfig.tiles(f_local, config.block_k)
     out_dtype = jnp.promote_types(act.dtype, w_down_local.dtype)
 
     if world == 1:
-        return moe_utils.grouped_gemm(act, w_down_local).astype(out_dtype)
+        return jnp.einsum("ecf,efd->ecd", act, w_down_local,
+                          preferred_element_type=jnp.float32).astype(out_dtype)
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
-        grid=(world, E, n_d),
+        grid=(world, E, n_d, n_k),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),               # act
-            pl.BlockSpec((1, f_local, bd), lambda s, e, j, me_ref: (e, 0, j)),
+            pl.BlockSpec((1, bk, bd), lambda s, e, j, kk, me_ref: (e, kk, j)),
         ],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),         # (E, cap, d)
         scratch_shapes=[
             pltpu.HBM((world - 1, E, capacity, d), out_dtype),  # partials
-            pltpu.VMEM((capacity, f_local), act.dtype),      # dst rows
+            pltpu.VMEM((capacity, bk), act.dtype),           # dst row tile
             pltpu.VMEM((2, capacity, bd), out_dtype),        # send buffer
-            pltpu.VMEM((capacity, bd), jnp.float32),         # accumulator
+            pltpu.VMEM((capacity, bd), jnp.float32),         # k-accumulator
+            pltpu.VMEM((capacity, bd), jnp.float32),         # fold accumulator
             pltpu.VMEM((capacity, bd), out_dtype),           # remote tile
             pltpu.VMEM((capacity, bd), out_dtype),           # cast-out tile
             common.dma_sems(2),
@@ -300,7 +337,8 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
     )
     return pl.pallas_call(
         functools.partial(_group_gemm_rs_kernel, axis=axis, world=world,
-                          n_e=E, n_d=n_d, bd=bd, cap=capacity),
+                          n_e=E, n_d=n_d, n_k=n_k, bd=bd, bk=bk,
+                          cap=capacity),
         out_shape=jax.ShapeDtypeStruct((E, capacity, d), out_dtype),
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(

@@ -39,6 +39,29 @@ class RoutingPlan:
     n_dropped: jax.Array    # () int32: (token, k) pairs lost to capacity
 
 
+def sort_to_capacity(keys, n_buckets: int, capacity: int):
+    """Shared core of every routing path (the role of the reference's CUDA
+    alignment op): stable-sort flat bucket keys, assign each element a slot
+    within its bucket's capacity block. Keys >= ``n_buckets`` sort to the
+    tail and are never kept.
+
+    Returns (order, keys_sorted, slot, kept, counts, n_dropped): ``counts``
+    clamped to capacity; ``n_dropped`` counts in-range keys lost to
+    overflow (observable, never silent — ADVICE r1)."""
+    order = jnp.argsort(keys, stable=True)
+    keys_sorted = keys[order]
+    counts = jnp.bincount(keys_sorted, length=n_buckets + 1)[:n_buckets]
+    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
+                              jnp.cumsum(counts)[:-1]])
+    slot = jnp.arange(keys_sorted.shape[0]) - starts[
+        jnp.clip(keys_sorted, 0, n_buckets - 1)]
+    in_range = keys_sorted < n_buckets
+    kept = in_range & (slot < capacity)
+    n_dropped = jnp.sum(in_range & ~kept).astype(jnp.int32)
+    return (order, keys_sorted, slot, kept,
+            jnp.minimum(counts, capacity), n_dropped)
+
+
 def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
                    capacity: int) -> RoutingPlan:
     """Build the dispatch plan: flat (token, k) pairs sorted by destination
@@ -55,19 +78,14 @@ def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
     flat_expert = topk_ids.reshape(-1)
     flat_weight = topk_weights.reshape(-1)
     dest = flat_expert // epr
-    order = jnp.argsort(dest, stable=True)
-    dest_sorted = dest[order]
-    counts = jnp.bincount(dest_sorted, length=world)
-    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
-                              jnp.cumsum(counts)[:-1]])
-    slot = jnp.arange(dest_sorted.shape[0]) - starts[dest_sorted]
-    kept = slot < capacity
+    order, dest_sorted, slot, kept, counts, n_dropped = sort_to_capacity(
+        dest, world, capacity)
     return RoutingPlan(order=order, dest=dest_sorted,
                        slot=jnp.where(kept, slot, 0),
-                       counts=jnp.minimum(counts, capacity), kept=kept,
+                       counts=counts, kept=kept,
                        expert=flat_expert[order],
                        topk_weight=flat_weight[order],
-                       n_dropped=jnp.sum(~kept).astype(jnp.int32))
+                       n_dropped=n_dropped)
 
 
 def scatter_to_capacity(x, plan: RoutingPlan, *, world: int, capacity: int):
@@ -115,16 +133,10 @@ def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
     flat = recv_tokens.reshape(world * cap, hidden)
     ids = recv_ids.reshape(world * cap)
     valid = (jnp.arange(world * cap) % cap) < jnp.repeat(recv_counts, cap)
+    # Invalid tokens key to the tail bucket (n_local_experts) -> never kept.
     local = jnp.where(valid & (ids >= 0), ids - expert_base, n_local_experts)
-    # Sort by local expert; invalid tokens sort to the tail bucket.
-    order = jnp.argsort(local, stable=True)
-    local_sorted = local[order]
-    counts = jnp.bincount(local_sorted, length=n_local_experts + 1)[:n_local_experts]
-    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
-                              jnp.cumsum(counts)[:-1]])
-    slot = jnp.arange(local_sorted.shape[0]) - starts[
-        jnp.clip(local_sorted, 0, n_local_experts - 1)]
-    kept = (local_sorted < n_local_experts) & (slot < expert_capacity)
+    order, local_sorted, slot, kept, counts, n_dropped = sort_to_capacity(
+        local, n_local_experts, expert_capacity)
     # Out-of-bounds index for masked entries -> dropped by mode="drop".
     e_idx = jnp.where(kept, local_sorted, n_local_experts)
     grouped = jnp.zeros((n_local_experts, expert_capacity, hidden), flat.dtype)
@@ -132,9 +144,7 @@ def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
     src_flat_idx = jnp.full((n_local_experts, expert_capacity), -1, jnp.int32)
     src_flat_idx = src_flat_idx.at[e_idx, slot].set(
         order.astype(jnp.int32), mode="drop")
-    n_dropped = jnp.sum((local_sorted < n_local_experts) & ~kept
-                        ).astype(jnp.int32)
-    return grouped, jnp.minimum(counts, expert_capacity), src_flat_idx, n_dropped
+    return grouped, counts, src_flat_idx, n_dropped
 
 
 def scatter_back_from_experts(expert_out, src_flat_idx, *, world: int,
@@ -161,14 +171,8 @@ def route_to_experts(x, topk_ids, *, n_experts: int, capacity: int):
     slots zero, slot (n, k) — each pair's slot in its expert's block,
     kept (n, k) bool, n_dropped () int32)."""
     n, k = topk_ids.shape
-    flat = topk_ids.reshape(-1)
-    order = jnp.argsort(flat, stable=True)
-    sorted_e = flat[order]
-    counts = jnp.bincount(sorted_e, length=n_experts)
-    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
-                              jnp.cumsum(counts)[:-1]])
-    slot_sorted = jnp.arange(n * k) - starts[sorted_e]
-    kept_sorted = slot_sorted < capacity
+    order, sorted_e, slot_sorted, kept_sorted, _, n_dropped = (
+        sort_to_capacity(topk_ids.reshape(-1), n_experts, capacity))
     e_idx = jnp.where(kept_sorted, sorted_e, n_experts)   # OOB -> dropped
     rows = jnp.repeat(x, k, axis=0)[order]
     grid = jnp.zeros((n_experts, capacity, x.shape[-1]), x.dtype)
@@ -178,8 +182,7 @@ def route_to_experts(x, topk_ids, *, n_experts: int, capacity: int):
     slot = jnp.zeros((n * k,), jnp.int32).at[order].set(
         slot_sorted.astype(jnp.int32))
     kept = jnp.zeros((n * k,), bool).at[order].set(kept_sorted)
-    return (grid, slot.reshape(n, k), kept.reshape(n, k),
-            jnp.sum(~kept_sorted).astype(jnp.int32))
+    return grid, slot.reshape(n, k), kept.reshape(n, k), n_dropped
 
 
 def combine_from_experts(out_grid, topk_ids, topk_weights, slot, kept):

@@ -177,20 +177,42 @@ def ring_reduce_scatter(x_local, *, axis: str = "tp", interpret=None):
 
 
 def reduce_scatter(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
-                   method: str = "auto", interpret=None):
+                   method: str = "auto", dcn_axis: str | None = None,
+                   interpret=None):
     """Standalone reduce-scatter over a mesh axis.
 
     ``x_stacked``: global ``(world, world*m, ...)``, device ``r`` holding its
     full contribution ``[r]``. Returns global ``(world*m, ...)`` sharded
     ``P(axis)``: segment ``r`` = sum over devices of their segment ``r``.
+
+    Pass ``dcn_axis`` on a multi-slice ``(dcn, ici)`` mesh: AUTO then
+    dispatches to the hierarchical 2D method (reference 2D RS,
+    reduce_scatter.py:45), with ``axis`` as the intra-slice axis. On that
+    path the stacked leading dim (and the per-device contribution's
+    segment count) is the TOTAL device count
+    ``mesh.shape[dcn_axis] * mesh.shape[axis]`` (dcn-major rank order).
     """
     mesh = mesh or get_default_mesh()
     world = mesh.shape[axis]
     if method == "auto":
-        method = "oneshot" if x_stacked.nbytes // world <= (1 << 22) else "ring"
+        if dcn_axis and mesh.shape.get(dcn_axis, 1) > 1:
+            method = "ring_2d"
+        else:
+            method = ("oneshot" if x_stacked.nbytes // world <= (1 << 22)
+                      else "ring")
+    if method == "ring_2d":
+        if dcn_axis is None:
+            raise ValueError("method ring_2d needs dcn_axis (a (dcn, ici) "
+                             "mesh; see runtime.mesh.make_2d_mesh)")
+        from triton_distributed_tpu.kernels.collective_2d import (
+            reduce_scatter_2d,
+        )
+
+        return reduce_scatter_2d(x_stacked, mesh=mesh, ici_axis=axis,
+                                 dcn_axis=dcn_axis, interpret=interpret)
     if method not in ("oneshot", "ring"):
         raise ValueError(f"unknown reduce_scatter method {method!r}: "
-                         f"expected 'auto', 'oneshot', or 'ring'")
+                         f"expected 'auto', 'oneshot', 'ring', or 'ring_2d'")
     return _build_rs(mesh, axis, method, interpret, x_stacked.ndim - 1)(
         x_stacked).reshape(x_stacked.shape[1:])
 

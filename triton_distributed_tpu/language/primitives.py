@@ -64,8 +64,15 @@ def notify(sem_ref, peer=None, *, axis: str = "tp", inc: int = 1,
     ``peer=None`` signals the local semaphore; otherwise ``peer`` is the
     target's rank *along ``axis``* (other mesh axes keep this device's
     coordinates — correct in multi-axis dp×tp×... meshes). TPU semaphores
-    accumulate, so only SIGNAL_ADD is supported natively; the scope argument
-    is parity-only — ICI reaches every device in the mesh axis.
+    accumulate, so only SIGNAL_ADD is supported natively.
+
+    Scope: device-initiated signaling reaches any device in the ICI domain
+    (the reference's "gpu"/"intra_node" scopes); there is NO device-initiated
+    signal across DCN — the hardware has no such op. The reference's
+    "inter_node" scope maps to the hierarchical collectives in
+    ``kernels/collective_2d.py`` (intra-slice Pallas + inter-slice XLA leg),
+    not to this primitive; ``comm_scope`` is accepted for ported-kernel
+    parity within the ICI domain only.
     """
     del comm_scope
     if sig_op != SIGNAL_ADD:

@@ -142,6 +142,25 @@ class Topology:
         return self.num_slices > 1
 
 
+def make_2d_mesh(topology: Topology | None = None, *,
+                 ici_axis: str = "ici", dcn_axis: str = "dcn",
+                 devices: Sequence[jax.Device] | None = None,
+                 set_default: bool = False) -> Mesh:
+    """Build the ``(dcn, ici)`` collective mesh from the detected topology —
+    the consumer of ``Topology.num_slices`` (the reference keys its
+    "intra_node" vs "inter_node" method choice off its NVLink/NIC probe the
+    same way, allgather.py:57). Devices are grouped so the ``ici_axis``
+    spans one slice (sorted by ``slice_index``); the 2D collectives in
+    ``kernels/collective_2d.py`` then ride ICI inside a slice and DCN
+    across."""
+    topo = topology or Topology.detect()
+    devices = list(devices if devices is not None else jax.devices())
+    devices.sort(key=lambda d: (getattr(d, "slice_index", 0), d.id))
+    return make_mesh({dcn_axis: topo.num_slices,
+                      ici_axis: len(devices) // topo.num_slices},
+                     devices=devices, set_default=set_default)
+
+
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
