@@ -18,6 +18,11 @@ from triton_distributed_tpu.kernels.allreduce import (  # noqa: F401
     oneshot_all_reduce,
     twoshot_all_reduce,
 )
+from triton_distributed_tpu.kernels.ll_allgather import (  # noqa: F401
+    ll_all_gather,
+    ll_all_gather_device,
+    make_ll_staging,
+)
 from triton_distributed_tpu.kernels.collective_2d import (  # noqa: F401
     all_gather_2d,
     all_gather_2d_device,

@@ -52,6 +52,7 @@ class AllGatherMethod(enum.Enum):
     ALL2ALL = "all2all"
     RING_1D = "ring_1d"
     RING_2D = "ring_2d"   # intra-slice ring + DCN leg (collective_2d.py)
+    LL = "ll"             # persistent-staging low-latency (ll_allgather.py)
 
 
 def choose_all_gather_method(world: int, nbytes: int,

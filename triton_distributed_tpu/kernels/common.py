@@ -33,6 +33,7 @@ _COLLECTIVE_IDS: dict[str, int] = {
     for i, name in enumerate([
         "ag_ring",
         "ag_a2a",
+        "ag_ll",
         "rs_oneshot",
         "rs_ring",
         "ar_oneshot",

@@ -82,7 +82,7 @@ class MoEOverlapConfig:
 def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
                           acc_ref, send_sems, recv_sems, copy_sem, *,
                           axis: str, world: int, n_e: int, n_f: int,
-                          n_k: int, bk: int, cap: int):
+                          n_k: int, bk: int):
     s = pl.program_id(0)
     e = pl.program_id(1)
     j = pl.program_id(2)
@@ -193,7 +193,7 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     )
     up = pl.pallas_call(
         functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
-                          n_e=E, n_f=n_f, n_k=n_k, bk=bk, cap=capacity),
+                          n_e=E, n_f=n_f, n_k=n_k, bk=bk),
         out_shape=jax.ShapeDtypeStruct((E, world * capacity, f_local),
                                        out_dtype),
         grid_spec=grid_spec,

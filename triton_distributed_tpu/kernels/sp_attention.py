@@ -313,16 +313,21 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
 
 def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
                         kv_len=None, scale: float | None = None,
-                        interpret=None):
+                        ll_staging=None, ll_epoch=None, interpret=None):
     """Per-device distributed decode attention (composable inside shard_map).
 
     q: (B, Hq, dh) replicated; k/v_cache_local: (B, Hkv, m_kv, dh) — the KV
     sequence dim sharded over ``axis``, GQA-native (Hq % Hkv == 0). Each
     device computes its split-KV partial (out, LSE) with the Pallas
-    streaming-softmax kernel; partials are ring-allgathered and LSE-merged
+    streaming-softmax kernel; partials are allgathered and LSE-merged
     (reference flash_decode.py:482 inter-rank combine). ``kv_len`` is this
     device's LOCAL valid cache length (callers with a global offset pass
     ``clip(offset - me*m_kv, 0, m_kv)``).
+
+    Pass ``ll_staging``/``ll_epoch`` (see ``kernels.ll_allgather``) to ride
+    the partial exchange on the low-latency allgather — the reference pairs
+    flash-decode with its LL protocol for exactly this exchange
+    (sp_flash_decode_layer.py:83). Returns (out, staging) in that case.
     """
     world = jax.lax.axis_size(axis)
     B, H, dh = q.shape
@@ -331,15 +336,25 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
         interpret=interpret)
 
     if world == 1:
-        return out_local.astype(q.dtype)
+        out = out_local.astype(q.dtype)
+        return (out, ll_staging) if ll_staging is not None else out
 
     # Pack (out, lse) rows; gather all ranks' partials over ICI.
     packed = jnp.concatenate(
         [out_local.reshape(B * H, dh), lse_local.reshape(B * H, 1)], axis=-1)
-    gathered = ring_all_gather(packed, axis=axis, interpret=interpret)
+    if ll_staging is not None:
+        from triton_distributed_tpu.kernels.ll_allgather import (
+            ll_all_gather_device,
+        )
+
+        gathered, ll_staging = ll_all_gather_device(
+            packed, ll_staging, ll_epoch, axis=axis, interpret=interpret)
+    else:
+        gathered = ring_all_gather(packed, axis=axis, interpret=interpret)
     gathered = gathered.reshape(world, B, H, dh + 1)
     outs, lses = gathered[..., :dh], gathered[..., dh]     # (w,B,H,dh), (w,B,H)
 
     # LSE merge: softmax over ranks weights each partial.
     w = jax.nn.softmax(lses, axis=0)[..., None]
-    return jnp.sum(w * outs, axis=0).astype(q.dtype)
+    out = jnp.sum(w * outs, axis=0).astype(q.dtype)
+    return (out, ll_staging) if ll_staging is not None else out

@@ -5,3 +5,4 @@ from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
 from triton_distributed_tpu.layers.tp_attn import TPAttn  # noqa: F401
 from triton_distributed_tpu.layers.sp_flash_decode_layer import SpGQAFlashDecodeAttention  # noqa: F401
 from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer  # noqa: F401
+from triton_distributed_tpu.layers.allgather_layer import AllGatherLayer  # noqa: F401

@@ -1,0 +1,108 @@
+"""AllGather layer: one object exposing every allgather variant.
+
+TPU-native analog of the reference's ``layers/nvidia/low_latency_allgather_
+layer.py`` (``AllGatherLayer`` :30 — push/pull/LL variants behind one
+forward, holding the symmetric buffers and the ``signal_target`` epoch
+counter). Here the layer owns the persistent LL staging workspace
+(``runtime/symm.py``) and the epoch counter, and dispatches ring / a2a /
+low-latency per call or automatically by message size."""
+
+from __future__ import annotations
+
+from jax.sharding import Mesh
+
+from triton_distributed_tpu.kernels.allgather import (
+    AllGatherMethod,
+    a2a_all_gather,
+    choose_all_gather_method,
+    ring_all_gather,
+)
+from triton_distributed_tpu.kernels.ll_allgather import (
+    ll_all_gather_device,
+    make_ll_staging,
+)
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+# LL staging pays off below roughly the same size the a2a/ring crossover
+# uses; decode messages are typically a few hundred KB.
+_LL_MAX_BYTES = 1 << 20
+
+_instance_counter = 0
+
+
+class AllGatherLayer:
+    """Stateful per-shape allgather front-end (reference ctor: max shape +
+    dtype + group; here: local shape + dtype + mesh axis).
+
+    ``__call__`` is a PER-DEVICE function: use inside ``shard_map``. The
+    LL variant threads the layer-held persistent staging and bumps the
+    epoch counter each call (the ``signal_target`` rotation)."""
+
+    def __init__(self, local_shape, dtype, *, mesh: Mesh | None = None,
+                 axis: str = "tp", name: str | None = None):
+        global _instance_counter
+        self.mesh = mesh or get_default_mesh()
+        self.axis = axis
+        self.local_shape = tuple(local_shape)
+        self.dtype = dtype
+        if name is None:
+            # Unique per instance: two layers sharing one staging buffer
+            # (with independent epoch counters) would corrupt each other's
+            # gathers (r2 review).
+            name = f"ag_layer#{_instance_counter}"
+            _instance_counter += 1
+        self._ws = make_ll_staging(self.local_shape, dtype, mesh=self.mesh,
+                                   axis=axis, name=name)
+        self.epoch = 0
+
+    def staging(self):
+        """The persistent staging array — pass its per-device block to
+        ``__call__`` when using the LL method inside shard_map."""
+        return self._ws.array
+
+    def rebind_staging(self, staging):
+        """Store the staging returned by the LL kernel (aliased buffer) so
+        the next call reuses it."""
+        self._ws.array = staging
+
+    def next_epoch(self):
+        e = self.epoch
+        self.epoch += 1
+        return e
+
+    def __call__(self, x_local, *, method: AllGatherMethod | str =
+                 AllGatherMethod.AUTO, staging=None, epoch=None,
+                 interpret=None):
+        """Per-device allgather of ``x_local (m, ...)`` -> ``(world*m, ...)``.
+        For the LL method pass ``staging`` (this device's block of
+        ``self.staging()``) and ``epoch``; returns (gathered, staging).
+        Other methods return just the gathered array. An explicitly
+        requested method is always honored — AUTO picks LL only when
+        staging is available, the epoch is known, and the message is small
+        (large transfers are bandwidth-bound; the ring wins)."""
+        if isinstance(method, str):
+            method = AllGatherMethod(method)
+        world = self.mesh.shape[self.axis]
+        nbytes = x_local.nbytes if hasattr(x_local, "nbytes") else 0
+        if method is AllGatherMethod.AUTO:
+            if (staging is not None and epoch is not None
+                    and nbytes <= _LL_MAX_BYTES):
+                method = AllGatherMethod.LL
+            else:
+                method = choose_all_gather_method(world, nbytes)
+        if method is AllGatherMethod.LL:
+            if staging is None or epoch is None:
+                raise ValueError("LL allgather needs staging + epoch "
+                                 "(layer.staging() / layer.next_epoch())")
+            return ll_all_gather_device(x_local, staging, epoch,
+                                        axis=self.axis, interpret=interpret)
+        if method is AllGatherMethod.RING_1D:
+            return ring_all_gather(x_local, axis=self.axis,
+                                   interpret=interpret)
+        if method is AllGatherMethod.ALL2ALL:
+            return a2a_all_gather(x_local, axis=self.axis,
+                                  interpret=interpret)
+        raise ValueError(
+            f"AllGatherLayer spans one mesh axis; method {method.value!r} "
+            f"is not supported here (use kernels.collective_2d for the "
+            f"hierarchical 2D path)")

@@ -38,11 +38,16 @@ class SpGQAFlashDecodeAttention:
                 f"{self.num_kv_heads}")
 
     def __call__(self, q, k_cache_local, v_cache_local, *, kv_len=None,
-                 interpret=None):
+                 ll_staging=None, ll_epoch=None, interpret=None):
         """q: (B, Hq, dh); k/v_cache_local: (B, Hkv, m_kv, dh) with the KV
         sequence dim sharded over ``axis``. ``kv_len`` is the GLOBAL valid
         cache length (preallocated-cache decode) — each rank masks its own
-        shard slice; None = the full cache. Returns (B, Hq, dh)."""
+        shard slice; None = the full cache. Returns (B, Hq, dh).
+
+        ``ll_staging``/``ll_epoch`` route the partial exchange over the
+        low-latency allgather (the decode-loop fast path; the reference's
+        adaptive symm buffer, sp_flash_decode_layer.py:116) — the return
+        becomes (out, staging) to thread into the next decode step."""
         local_len = None
         if kv_len is not None:
             m_kv = k_cache_local.shape[2]
@@ -50,4 +55,5 @@ class SpGQAFlashDecodeAttention:
             local_len = jnp.clip(kv_len - me * m_kv, 0, m_kv)
         return flash_decode_device(q, k_cache_local, v_cache_local,
                                    axis=self.axis, kv_len=local_len,
+                                   ll_staging=ll_staging, ll_epoch=ll_epoch,
                                    interpret=interpret)
