@@ -276,6 +276,21 @@ def ag_gemm_single_chip(a, b, *, block_m: int | None = None,
     )(a, b)
 
 
+def ag_gemm_single_chip_autotuned(a, b, *, interpret=None):
+    """Single-chip matmul with ON-CHIP tuned blocks: first call at a given
+    (m, k, n, dtype) times the candidate blockings through the contextual
+    autotuner (cached in memory + on disk), later calls reuse the winner —
+    the reference's ``@contextual_autotune`` applied to the ag_gemm/gemm_rs
+    consumer GEMM (autotuner.py:97)."""
+    from triton_distributed_tpu.runtime.autotuner import tuned_matmul_blocks
+
+    m, k = a.shape
+    _, n = b.shape
+    bm, bn, bk = tuned_matmul_blocks(m, k, n, str(a.dtype))
+    return ag_gemm_single_chip(a, b, block_m=bm, block_n=bn, block_k=bk,
+                               interpret=interpret)
+
+
 # ---------------------------------------------------------------------------
 # Host-level wrapper
 # ---------------------------------------------------------------------------

@@ -7,10 +7,16 @@ BarrierAllContext, perf_func, dist_print, group_profile).
 
 from triton_distributed_tpu.runtime.mesh import (  # noqa: F401
     make_mesh,
+    make_2d_mesh,
     get_default_mesh,
     set_default_mesh,
     initialize_distributed,
     Topology,
+)
+from triton_distributed_tpu.runtime.autotuner import (  # noqa: F401
+    ContextualAutotuner,
+    contextual_autotune,
+    tuned_matmul_blocks,
 )
 from triton_distributed_tpu.runtime.platform import (  # noqa: F401
     on_tpu,

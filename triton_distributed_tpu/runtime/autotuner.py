@@ -1,0 +1,247 @@
+"""Contextual autotuner: thunk-level timing with a cross-process config vote.
+
+TPU-native analog of the reference's ``python/triton_dist/autotuner.py``
+(``ContextualAutoTuner`` :43, ``@contextual_autotune(is_dist=True)`` :97,
+docs/autotuner.md): because overlap ops are multi-kernel and side-effectful,
+the unit of tuning is a whole THUNK (everything the op launches), not one
+kernel; and because every process must run the same config (SPMD — a
+mismatched block size deadlocks a collective), per-process timings are
+combined across processes and every process picks the argmin of the SAME
+summed vector (the reference all-reduces timings for exactly this reason).
+
+Timing methodology: the axon/TPU dispatch path adds tens of ms of per-call
+latency, so a naive wall-clock of one call measures the tunnel, not the
+kernel. ``perf_thunk`` times a jitted ``lax.fori_loop`` of the op with a
+forced data dependence (the bench.py methodology): constant overhead
+cancels in the short/long slope.
+
+Choices are cached in-process and on disk (keyed by op name + shapes +
+mesh fingerprint), so engine startup skips re-tuning — set
+``TDT_AUTOTUNE_CACHE=/path.json`` to relocate, ``TDT_AUTOTUNE=0`` to
+disable tuning entirely (first config wins).
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+import statistics
+import time
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+_DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "triton_distributed_tpu",
+    "autotune.json")
+
+_memory_cache: dict[str, Any] = {}
+
+
+def _cache_path() -> str:
+    return os.environ.get("TDT_AUTOTUNE_CACHE", _DEFAULT_CACHE)
+
+
+def _load_disk_cache() -> dict:
+    try:
+        with open(_cache_path()) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def _store_disk_cache(key: str, value) -> None:
+    path = _cache_path()
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        cache = _load_disk_cache()
+        cache[key] = value
+        with open(path, "w") as f:
+            json.dump(cache, f, indent=1, sort_keys=True)
+    except OSError:
+        pass  # unwritable cache dir: tuning still works, just not persisted
+
+
+def clear_cache(disk: bool = False) -> None:
+    _memory_cache.clear()
+    if disk:
+        try:
+            os.remove(_cache_path())
+        except OSError:
+            pass
+
+
+def perf_thunk(thunk: Callable[[], Any], *, iters: tuple[int, int] = (8, 24),
+               calls: int = 3) -> float:
+    """Median per-iteration ms of ``thunk`` via the short/long slope
+    (dispatch overhead cancels). ``thunk`` must return jax array(s); it is
+    re-invoked ``iters`` times per measurement inside host loops — for ops
+    already amortized in-jit, pass ``iters=(1, 2)``."""
+    def run(n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            out = thunk()
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) * 1e3
+
+    short, long_ = iters
+    run(short)  # compile + warm
+    samples = []
+    for _ in range(calls):
+        s = run(short)
+        l = run(long_)
+        samples.append(max((l - s) / (long_ - short), 1e-6))
+    return statistics.median(samples)
+
+
+def _vote_across_processes(timings: Sequence[float]) -> int:
+    """Every process picks argmin of the SAME summed timing vector (the
+    reference's cross-rank all-reduce of timings, autotuner.py:97)."""
+    t = jnp.asarray(timings, jnp.float32)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        t = multihost_utils.process_allgather(t).sum(axis=0)
+    return int(jnp.argmin(t))
+
+
+class ContextualAutotuner:
+    """Times ``make_thunk(config)`` for every candidate config and returns
+    the globally-agreed winner; caches by ``key`` in memory and on disk."""
+
+    def __init__(self, name: str, configs: Sequence[Any], *,
+                 iters: tuple[int, int] = (8, 24), calls: int = 3):
+        if not configs:
+            raise ValueError("need at least one config")
+        self.name = name
+        self.configs = list(configs)
+        self.iters = iters
+        self.calls = calls
+
+    def _key(self, context_key: str) -> str:
+        return f"{self.name}|{context_key}"
+
+    def tune(self, make_thunk: Callable[[Any], Callable[[], Any]],
+             context_key: str):
+        """Return the winning config for this context (cached)."""
+        key = self._key(context_key)
+        if key in _memory_cache:
+            return self.configs[_memory_cache[key]]
+        disk = _load_disk_cache()
+        if key in disk and 0 <= disk[key] < len(self.configs):
+            _memory_cache[key] = disk[key]
+            return self.configs[disk[key]]
+        if os.environ.get("TDT_AUTOTUNE", "1") == "0":
+            _memory_cache[key] = 0
+            return self.configs[0]
+
+        timings = []
+        for cfg in self.configs:
+            try:
+                thunk = make_thunk(cfg)
+                timings.append(perf_thunk(thunk, iters=self.iters,
+                                          calls=self.calls))
+            except Exception:
+                timings.append(float("inf"))  # infeasible config loses
+        if all(t == float("inf") for t in timings):
+            raise RuntimeError(
+                f"autotune {key}: every candidate config failed")
+        best = _vote_across_processes(timings)
+        _memory_cache[key] = best
+        _store_disk_cache(key, best)
+        return self.configs[best]
+
+
+def contextual_autotune(configs: Sequence[Any], *, name: str | None = None,
+                        key_fn: Callable[..., str] | None = None,
+                        iters: tuple[int, int] = (8, 24)):
+    """Decorator form (reference ``@contextual_autotune``, autotuner.py:97):
+    wraps ``fn(config, *args, **kw)``; on first call per context the
+    candidates are timed as whole thunks over the live arguments, then the
+    cached winner is used.
+
+    ``key_fn(*args, **kw) -> str`` scopes the cache (default: the
+    shapes/dtypes of array arguments)."""
+    def default_key(*args, **kw):
+        parts = [f"{tuple(a.shape)}:{a.dtype}" for a in args
+                 if hasattr(a, "shape")]
+        return ",".join(parts)
+
+    def deco(fn):
+        tuner = ContextualAutotuner(name or fn.__name__, configs,
+                                    iters=iters)
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kw):
+            ctx = (key_fn or default_key)(*args, **kw)
+            cfg = tuner.tune(
+                lambda c: (lambda: fn(c, *args, **kw)), ctx)
+            return fn(cfg, *args, **kw)
+
+        wrapper.tuner = tuner
+        return wrapper
+
+    return deco
+
+
+# ---------------------------------------------------------------------------
+# Stock tuners for the flagship ops
+# ---------------------------------------------------------------------------
+
+# Candidate blocks: on-chip sweep winners (tools/sweep_matmul.py) + safe
+# fallbacks covering small/ragged shapes.
+MATMUL_BLOCK_CANDIDATES: tuple[tuple[int, int, int], ...] = (
+    (1024, 640, 1024),
+    (1024, 512, 1024),
+    (512, 1024, 1024),
+    (512, 512, 1024),
+    (512, 640, 512),
+    (256, 1024, 512),
+    (512, 256, 512),
+)
+
+
+@functools.lru_cache(maxsize=None)
+def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
+    """On-chip tune of the single-chip matmul blocks at (m, k, n) — the
+    consumer GEMM of ag_gemm / gemm_rs (block_n doubles as the overlap
+    kernels' N tile). Returns (bm, bn, bk); cached in memory and on disk."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_single_chip,
+    )
+
+    feasible = [c for c in MATMUL_BLOCK_CANDIDATES
+                if m % min(c[0], m) == 0 and n % min(c[1], n) == 0
+                and k % min(c[2], k) == 0]
+    if not feasible:
+        feasible = [(min(1024, m), min(640, n), min(1024, k))]
+    # The thunk loops 8x in-jit already; small host iters just cancel the
+    # dispatch overhead in the slope.
+    tuner = ContextualAutotuner("matmul_blocks", feasible, iters=(2, 6))
+
+    dtype = jnp.dtype(dtype_str)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), dtype)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype)
+
+    def make_thunk(cfg):
+        bm, bn, bk = (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))
+
+        @jax.jit
+        def loop(a, b):
+            def body(_, acc):
+                bb = b + (acc[0, 0] * 0).astype(b.dtype)
+                return acc + ag_gemm_single_chip(
+                    a, bb, block_m=bm, block_n=bn, block_k=bk
+                ).astype(jnp.float32)
+            return jax.lax.fori_loop(
+                0, 8, body, jnp.zeros((m, n), jnp.float32))
+
+        loop(a, b).block_until_ready()  # compile check before timing
+        return lambda: loop(a, b)
+
+    cfg = tuner.tune(make_thunk, f"{m}x{k}x{n}:{dtype_str}:"
+                                 f"{jax.devices()[0].device_kind}")
+    return (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))
