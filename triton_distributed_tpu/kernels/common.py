@@ -87,9 +87,11 @@ def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):
     return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, axis=axis)
 
 
-def dma_sems(n: int):
-    """Scratch spec for an array of ``n`` DMA semaphores."""
-    return pltpu.SemaphoreType.DMA((n,))
+def dma_sems(shape: int | tuple):
+    """Scratch spec for an array of DMA semaphores (int n = 1-D of n)."""
+    if isinstance(shape, int):
+        shape = (shape,)
+    return pltpu.SemaphoreType.DMA(tuple(shape))
 
 
 # Per-kernel VMEM working-set target for collective staging buffers. Mosaic's

@@ -10,8 +10,8 @@ synchronization from the critical path: flag-in-data packing means a
 receiver can consume a slot the moment the flag matches the current epoch,
 and epoch-rotated flags make slot reuse safe WITHOUT a barrier between
 calls. The TPU translation keeps the two load-bearing ideas and drops the
-flag packing (a remote DMA's receive semaphore IS a per-transfer arrival
-flag — no byte-level polling needed):
+flag packing (an epoch-parity-indexed receive semaphore is a per-transfer
+arrival flag bound to its epoch — no byte-level polling needed):
 
 - **Persistent symmetric staging** (``runtime/symm.py`` workspaces): the
   receive buffer is allocated ONCE and threaded through every call as an
@@ -59,12 +59,22 @@ def _ll_ag_kernel(p_ref, x_ref, staging_ref, o_ref, staging_out, send_sems,
     # Push our shard into every peer's CURRENT-parity staging slot. The
     # staging array is input/output-aliased persistent state — live on every
     # device before this kernel even starts, so no entry barrier is needed.
+    #
+    # Recv semaphores are indexed by (epoch parity, source): dma.wait_send()
+    # only guarantees the LOCAL buffer drained, so a sender may enter epoch N
+    # while its N-1 push is still in flight, and two ICI DMAs to the same
+    # receiver are unordered — a shared per-source semaphore would let the
+    # epoch-N arrival satisfy the receiver's epoch-N-1 wait. Parity-tagged
+    # semaphores re-bind each wait to its epoch (the reference's
+    # signal_wait_until(CMP_EQ, signal_target) epoch check,
+    # low_latency_allgather.py:531); the double-buffer argument above bounds
+    # skew to <2 calls, so parity is enough.
     sends = []
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
         dma = common.remote_copy(
             x_ref, staging_ref.at[p, common.peer_slot(me, peer)],
-            send_sems.at[i], recv_sems.at[me], axis, peer)
+            send_sems.at[i], recv_sems.at[p, me], axis, peer)
         sends.append(dma)
 
     # Own shard straight into the output.
@@ -75,7 +85,7 @@ def _ll_ag_kernel(p_ref, x_ref, staging_ref, o_ref, staging_out, send_sems,
         @pl.when(src != me)
         def _consume(src=src):
             slot = common.peer_slot(src, me)
-            common.wait_recv(staging_ref.at[p, slot], recv_sems.at[src])
+            common.wait_recv(staging_ref.at[p, slot], recv_sems.at[p, src])
             common.local_copy(staging_ref.at[p, slot],
                               o_ref.at[pl.ds(src * m, m)], copy_sem)
     for dma in sends:
@@ -112,7 +122,7 @@ def ll_all_gather_device(x_local, staging, epoch, *, axis: str = "tp",
         input_output_aliases={2: 1},
         scratch_shapes=[
             common.dma_sems(world - 1),
-            common.dma_sems(world),
+            common.dma_sems((2, world)),
             pltpu.SemaphoreType.DMA(()),
         ],
         compiler_params=common.compiler_params(

@@ -75,11 +75,18 @@ class AllGatherLayer:
                  interpret=None):
         """Per-device allgather of ``x_local (m, ...)`` -> ``(world*m, ...)``.
         For the LL method pass ``staging`` (this device's block of
-        ``self.staging()``) and ``epoch``; returns (gathered, staging).
-        Other methods return just the gathered array. An explicitly
-        requested method is always honored — AUTO picks LL only when
-        staging is available, the epoch is known, and the message is small
-        (large transfers are bandwidth-bound; the ring wins)."""
+        ``self.staging()``) and ``epoch``.
+
+        Return type is decided by whether ``staging`` was passed, NOT by the
+        dispatched method: with staging the result is always
+        ``(gathered, staging)`` (non-LL paths return the input staging
+        unchanged), so a caller threading staging through a loop keeps a
+        stable structure even when AUTO re-routes a larger message to the
+        ring (r2 advisor). Without staging the bare gathered array is
+        returned. An explicitly requested method is always honored — AUTO
+        picks LL only when staging is available, the epoch is known, and the
+        message is small (large transfers are bandwidth-bound; the ring
+        wins)."""
         if isinstance(method, str):
             method = AllGatherMethod(method)
         world = self.mesh.shape[self.axis]
@@ -97,11 +104,13 @@ class AllGatherLayer:
             return ll_all_gather_device(x_local, staging, epoch,
                                         axis=self.axis, interpret=interpret)
         if method is AllGatherMethod.RING_1D:
-            return ring_all_gather(x_local, axis=self.axis,
-                                   interpret=interpret)
-        if method is AllGatherMethod.ALL2ALL:
-            return a2a_all_gather(x_local, axis=self.axis,
+            out = ring_all_gather(x_local, axis=self.axis,
                                   interpret=interpret)
+            return (out, staging) if staging is not None else out
+        if method is AllGatherMethod.ALL2ALL:
+            out = a2a_all_gather(x_local, axis=self.axis,
+                                 interpret=interpret)
+            return (out, staging) if staging is not None else out
         raise ValueError(
             f"AllGatherLayer spans one mesh axis; method {method.value!r} "
             f"is not supported here (use kernels.collective_2d for the "

@@ -125,17 +125,53 @@ class ContextualAutotuner:
 
     def tune(self, make_thunk: Callable[[Any], Callable[[], Any]],
              context_key: str):
-        """Return the winning config for this context (cached)."""
+        """Return the winning config for this context (cached).
+
+        The cache decision itself is COLLECTIVE in multi-process runs: the
+        disk cache is per-host and TDT_AUTOTUNE per-process, so hosts can
+        disagree on cache state — a cache-hit process skipping the vote while
+        a cache-miss process blocks in ``process_allgather`` hangs the job,
+        and divergent cached winners deadlock collectives (SPMD). Every
+        process first allgathers its (hit, index) pair; the cached winner is
+        used only if ALL processes agree, otherwise everyone re-tunes.
+        Memory-cache entries are exempt from the consensus round: they are
+        only ever written after a collective decision (consensus or vote
+        below), so they are process-consistent by construction — and the
+        early return keeps repeat calls of tuned ops collective-free."""
         key = self._key(context_key)
         if key in _memory_cache:
             return self.configs[_memory_cache[key]]
+        cached = None
         disk = _load_disk_cache()
         if key in disk and 0 <= disk[key] < len(self.configs):
-            _memory_cache[key] = disk[key]
-            return self.configs[disk[key]]
-        if os.environ.get("TDT_AUTOTUNE", "1") == "0":
-            _memory_cache[key] = 0
-            return self.configs[0]
+            cached = disk[key]
+        env_off = os.environ.get("TDT_AUTOTUNE", "1") == "0"
+        if env_off and cached is None:
+            cached = 0
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            pair = jnp.asarray(
+                [1 if cached is not None else 0,
+                 cached if cached is not None else -1,
+                 1 if env_off else 0], jnp.int32)
+            pairs = multihost_utils.process_allgather(pair)
+            all_hit = bool(pairs[:, 0].min() == 1)
+            agree = bool((pairs[:, 1] == pairs[0, 1]).all())
+            any_env_off = bool(pairs[:, 2].max() == 1)
+            if all_hit and agree:
+                cached = int(pairs[0, 1])
+            elif any_env_off:
+                # Tuning disabled on >=1 process: EVERY process must make the
+                # same participation decision (a lone env_off process taking
+                # config 0 while others enter the timing vote deadlocks), so
+                # consensus failure resolves to config 0 globally.
+                cached = 0
+            else:
+                cached = None
+        if cached is not None:
+            _memory_cache[key] = cached
+            return self.configs[cached]
 
         timings = []
         for cfg in self.configs:
