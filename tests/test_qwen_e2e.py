@@ -80,3 +80,35 @@ def test_cache_sharded_over_kv_heads(setup):
     kv = KVCache.create(config, B, mesh=mesh)
     # kv-head dim sharded tp-ways
     assert kv.k.sharding.shard_shape(kv.k.shape)[3] == config.n_kv_heads // 8
+
+
+def test_engine_aot_cache_roundtrip(mesh8, tmp_path, monkeypatch):
+    """aot_cache=True: tokens identical to the uncached engine, and a second
+    engine process-start loads the serialized step executable from disk
+    (source == "cache") instead of re-compiling (reference AOT library
+    cold-start role, tools/compile_aot.py:470)."""
+    import os
+
+    monkeypatch.setenv("TDT_AOT_CACHE", str(tmp_path))
+    cfg = ModelConfig.from_name("tiny")
+    prompts = np.arange(24, dtype=np.int32).reshape(8, 3) % cfg.vocab_size
+
+    base = Engine(cfg, mesh=mesh8, mode="xla", block_n=8)
+    golden = np.asarray(base.serve(prompts, gen_len=3))
+
+    cached = Engine(cfg, mesh=mesh8, mode="xla", block_n=8, aot_cache=True)
+    got = np.asarray(cached.serve(prompts, gen_len=3))
+    np.testing.assert_array_equal(got, golden)
+    assert os.listdir(tmp_path), "no serialized executables written"
+
+    from triton_distributed_tpu.tools.aot import AOTExecutableCache
+
+    again = Engine(cfg, mesh=mesh8, mode="xla", block_n=8, aot_cache=True)
+    step = again._step_fn("xla")
+    kv = again.new_cache(prompts.shape[0])
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (again.params, jnp.asarray(prompts), kv))
+    _, source = AOTExecutableCache().load_or_compile(
+        f"engine_step_{cfg.model_name}_xla", step, *abstract, mesh=mesh8)
+    assert source == "cache"

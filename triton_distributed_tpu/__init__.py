@@ -20,10 +20,15 @@ Layer map (mirrors reference SURVEY.md §1, re-based on the TPU stack):
                   overlap kernels; analog of python/triton_dist/kernels/)
   L7 layers    -> triton_distributed_tpu.layers     (TP_MLP, TP_Attn, EP, SP)
   L8 models    -> triton_distributed_tpu.models     (Qwen3, KV cache, engine)
-  Lx tools     -> triton_distributed_tpu.tools      (autotuner, AOT, profiler)
+  Lx tools     -> triton_distributed_tpu.tools      (autotuner re-export, AOT
+                  topology compile + serialized-executable cache, profiler;
+                  analog of python/triton_dist/tools/)
 
-The compute path is pure JAX/Pallas; native (C++) runtime components live in
-``csrc/`` and are loaded via ctypes (see triton_distributed_tpu.tools).
+The compute path is pure JAX/Pallas. The AOT path is ``tools.aot``:
+Mosaic-compilation of every flagship kernel against a detached TPU topology
+descriptor at production shapes (tests/test_mosaic_aot.py) plus a
+serialized-executable cache that cuts engine cold-start
+(``Engine(aot_cache=True)``).
 """
 
 __version__ = "0.1.0"

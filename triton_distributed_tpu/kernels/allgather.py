@@ -147,7 +147,7 @@ def _ag_call(kernel, x_local, *, axis: str, interpret, collective_id: int):
         out_shape=jax.ShapeDtypeStruct((world * m, *x_local.shape[1:]),
                                        x_local.dtype),
         in_specs=[common.any_spec()],
-        out_specs=common.any_spec(),
+        out_specs=common.hbm_spec(),
         scratch_shapes=[
             common.dma_sems(world - 1),   # send
             common.dma_sems(world),       # recv (slot-per-src; ring uses [:world-1])

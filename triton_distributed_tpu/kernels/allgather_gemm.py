@@ -52,14 +52,37 @@ from triton_distributed_tpu.runtime.platform import resolve_interpret
 class AGGEMMConfig:
     """Tile configuration (the analog of the reference's per-op context block
     sizes, allgather_gemm.py:404). ``block_n`` tiles the local N dimension of
-    the consumer matmul; the M dimension is walked per rank segment."""
+    the consumer matmul; the M dimension is walked per rank segment.
+    ``block_n=None`` auto-selects the largest lane-aligned divisor of
+    ``n_local`` whose VMEM working set fits Mosaic's scoped budget."""
 
-    block_n: int = 256
+    block_n: int | None = None
 
     def n_tiles(self, n_local: int) -> int:
-        if n_local % self.block_n:
-            raise ValueError(f"n_local {n_local} not divisible by block_n {self.block_n}")
+        if self.block_n is None or n_local % self.block_n:
+            raise ValueError(
+                f"n_local {n_local} not divisible by block_n {self.block_n}")
         return n_local // self.block_n
+
+    def resolve(self, m: int, k: int, n_local: int, in_itemsize: int,
+                out_itemsize: int) -> "AGGEMMConfig":
+        if self.block_n is not None:
+            return self
+        return AGGEMMConfig(block_n=_choose_consumer_block_n(
+            m, k, n_local, in_itemsize, out_itemsize))
+
+
+def _choose_consumer_block_n(m: int, k: int, n_local: int, in_isz: int,
+                             out_isz: int) -> int:
+    """Largest lane-aligned block_n whose consumer working set — the full
+    (m, k) A segment in VMEM plus double-buffered (k, bn) B and (m, bn) out
+    tiles — fits the scoped-VMEM budget Mosaic enforces (the enforcer
+    rejected block_n=640 at the Qwen3-32B TP=8 shape with exactly this
+    arithmetic: 18.75M > 16M)."""
+    return common.choose_lane_block(
+        n_local,
+        lambda bn: m * k * in_isz + 2 * k * bn * in_isz + 2 * m * bn * out_isz,
+        f"ag_gemm consumer block_n (A segment {m}x{k})")
 
 
 def _ag_gemm_kernel(me_ref, a_ref, b_ref, o_ref, a_full, a_vmem, send_sems,
@@ -121,11 +144,20 @@ def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
         # here would count as an explicit block and forfeit the automatic
         # XLA delegation on ragged/VMEM-infeasible shapes.
         return ag_gemm_single_chip(a_local, b_local, interpret=interpret)
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+    config = config.resolve(m, k, n_local, a_local.dtype.itemsize,
+                            out_dtype.itemsize)
     n_tiles = config.n_tiles(n_local)
     bn = config.block_n
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
 
+    # The gathered-A staging is an ANY-space OUTPUT, not scratch: Mosaic only
+    # allocates vmem/smem/semaphore scratch memrefs, and remote DMAs need a
+    # stable HBM buffer on every device — kernel outputs provide exactly that
+    # (the standard compiled-Pallas distributed pattern). The staging output
+    # is discarded by the caller; kernel arg order is unchanged because the
+    # staging ref moves from first-scratch to last-output position.
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(world, n_tiles),
@@ -133,28 +165,33 @@ def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
             pl.BlockSpec(memory_space=pl.ANY),     # a_local
             pl.BlockSpec((k, bn), lambda s, j, me_ref: (0, j)),  # b tile
         ],
-        out_specs=pl.BlockSpec(
-            (m, bn),
-            lambda s, j, me_ref: (jax.lax.rem(me_ref[0] + s, world), j),
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (m, bn),
+                lambda s, j, me_ref: (jax.lax.rem(me_ref[0] + s, world), j),
+            ),
+            common.hbm_spec(),                     # gathered-A staging
+        ],
         scratch_shapes=[
-            pltpu.HBM((world, m, k), a_local.dtype),  # gathered-A staging
             pltpu.VMEM((m, k), a_local.dtype),        # current segment
             common.dma_sems(world - 1),               # send
             common.dma_sems(world),                   # recv (slot per src)
             pltpu.SemaphoreType.DMA(()),              # local copies
         ],
     )
-    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
-    return pl.pallas_call(
+    out, _ = pl.pallas_call(
         functools.partial(_ag_gemm_kernel, axis=axis, world=world,
                           n_tiles=n_tiles),
-        out_shape=jax.ShapeDtypeStruct((world * m, n_local), out_dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((world * m, n_local), out_dtype),
+            jax.ShapeDtypeStruct((world, m, k), a_local.dtype),
+        ],
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("ag_gemm")),
         interpret=resolve_interpret(interpret),
     )(me, a_local, b_local)
+    return out
 
 
 # ---------------------------------------------------------------------------

@@ -101,13 +101,15 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     shape = x_local.shape
     rest = shape[1:]
     br = common.stage_row_tile(shape[0], rest, x_local.dtype.itemsize)
+    # Arrival staging is an ANY-space OUTPUT (discarded): Mosaic has no HBM
+    # scratch; kernel arg order unchanged (first-scratch -> last-output).
     return common.make_pallas_call(
         functools.partial(_oneshot_ar_kernel, axis=axis, world=world, br=br),
-        out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
+        out_shape=[jax.ShapeDtypeStruct(shape, x_local.dtype),
+                   jax.ShapeDtypeStruct((world - 1, *shape), x_local.dtype)],
         in_specs=[common.any_spec()],
-        out_specs=common.any_spec(),
+        out_specs=[common.hbm_spec()] * 2,
         scratch_shapes=[
-            pltpu.HBM((world - 1, *shape), x_local.dtype),  # remote arrivals
             common.dma_sems(world),
             common.dma_sems(world),
             pltpu.SemaphoreType.DMA(()),
@@ -117,7 +119,7 @@ def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
         ],
         collective_id=common.collective_id_for("ar_oneshot"),
         interpret=interpret,
-    )(x_local)
+    )(x_local)[0]
 
 
 # ---------------------------------------------------------------------------
@@ -183,14 +185,17 @@ def twoshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     m = shape[0] // world
     rest = shape[1:]
     br = common.stage_row_tile(m, rest, x_local.dtype.itemsize)
+    # Staging buffers are ANY-space OUTPUTS (discarded) — see one-shot.
     return common.make_pallas_call(
         functools.partial(_twoshot_ar_kernel, axis=axis, world=world, br=br),
-        out_shape=jax.ShapeDtypeStruct(shape, x_local.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct(shape, x_local.dtype),
+            jax.ShapeDtypeStruct((world - 1, m, *rest), x_local.dtype),
+            jax.ShapeDtypeStruct((m, *rest), x_local.dtype),  # ring send
+        ],
         in_specs=[common.any_spec()],
-        out_specs=common.any_spec(),
+        out_specs=[common.hbm_spec()] * 3,
         scratch_shapes=[
-            pltpu.HBM((world - 1, m, *rest), x_local.dtype),
-            pltpu.HBM((m, *rest), x_local.dtype),   # ring send staging
             common.dma_sems(world - 1),
             common.dma_sems(world - 1),
             common.dma_sems(world - 1),
@@ -202,7 +207,7 @@ def twoshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
         ],
         collective_id=common.collective_id_for("ar_twoshot"),
         interpret=interpret,
-    )(x_local)
+    )(x_local)[0]
 
 
 # ---------------------------------------------------------------------------

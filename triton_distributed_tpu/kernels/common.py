@@ -62,7 +62,13 @@ def collective_id_for(name: str) -> int:
         ) from None
 
 
-def compiler_params(collective_id: int) -> pltpu.CompilerParams:
+def compiler_params(collective_id: int | None) -> pltpu.CompilerParams:
+    """``collective_id=None`` for kernels that never touch the barrier
+    semaphore (Mosaic rejects an unused collective_id: "collective_id has to
+    be unspecified ... when not using a custom barrier" — e.g. the LL
+    allgather, whose whole point is needing no barrier)."""
+    if collective_id is None:
+        return pltpu.CompilerParams(has_side_effects=True)
     return pltpu.CompilerParams(has_side_effects=True, collective_id=collective_id)
 
 
@@ -94,6 +100,17 @@ def dma_sems(shape: int | tuple):
     return pltpu.SemaphoreType.DMA(tuple(shape))
 
 
+# Mosaic's scoped-VMEM stack limit per kernel (v5e/v5p default 16MB): the
+# budget every kernel's resident buffers + double-buffered pipeline blocks
+# must fit (verified against the real enforcer via AOT topology compiles,
+# tests/test_mosaic_aot.py). Block auto-selection targets the limit minus a
+# margin: the enforcer counts alignment padding and bookkeeping beyond the
+# plain buffer arithmetic (a 15.4M working set was rejected at the 16M
+# limit), so plan for ~14M.
+MOSAIC_VMEM_LIMIT = 16 * 2 ** 20
+MOSAIC_VMEM_MARGIN = 2 * 2 ** 20
+MOSAIC_VMEM_BUDGET = MOSAIC_VMEM_LIMIT - MOSAIC_VMEM_MARGIN
+
 # Per-kernel VMEM working-set target for collective staging buffers. Mosaic's
 # scoped-VMEM budget is ~16MB/core; collectives keep their row-tile buffers
 # well under half of it so the compiler has room for pipelining (ADVICE r1:
@@ -118,6 +135,20 @@ def stage_row_tile(m: int, rest: tuple, itemsize: int) -> int:
     for d in rest:
         rest_elems *= d
     return row_tile(m, rest_elems * (4 + 2 * itemsize))
+
+
+def choose_lane_block(dim: int, vmem_of_block, what: str) -> int:
+    """Largest 128-multiple divisor of ``dim`` (or ``dim`` itself) whose
+    working set ``vmem_of_block(block)`` fits ``MOSAIC_VMEM_BUDGET`` —
+    the shared block auto-selection of the overlap consumers
+    (ag_gemm / gemm_rs; per-kernel cost formula passed in)."""
+    for b in range(dim, 0, -1):
+        if dim % b == 0 and (b % 128 == 0 or b == dim) \
+                and vmem_of_block(b) <= MOSAIC_VMEM_BUDGET:
+            return b
+    raise ValueError(
+        f"no feasible {what}: resident buffers alone overflow the "
+        f"{MOSAIC_VMEM_BUDGET >> 20}MB VMEM budget")
 
 
 def peer_slot(src, me):
@@ -205,3 +236,11 @@ def make_pallas_call(kernel, *, out_shape, in_specs, out_specs, scratch_shapes,
 
 def any_spec():
     return pl.BlockSpec(memory_space=pl.ANY)
+
+
+def hbm_spec():
+    """Whole-array ref pinned to HBM. Kernel OUTPUTS that stage collective
+    traffic must use this rather than ANY: XLA may place a small ANY output
+    in VMEM (observed on the gemm_rs (m, n) output at TP=8 shapes, blowing
+    the 16MB scoped budget); remote DMAs need the buffer in HBM anyway."""
+    return pl.BlockSpec(memory_space=pltpu.MemorySpace.HBM)

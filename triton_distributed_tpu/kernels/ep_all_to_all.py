@@ -85,21 +85,19 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int):
                 sends_in[p].at[peer], recvs_out[p].at[me],
                 pay_sems[p].at[i], pay_sems[p].at[world - 1 + me], axis, peer))
         dmas.append(common.remote_copy(
-            counts_ref.at[pl.ds(peer, 1)], rcounts_ref.at[pl.ds(me, 1)],
+            counts_ref.at[peer], rcounts_ref.at[me],
             cnt_sems.at[i], cnt_sems.at[world - 1 + me], axis, peer))
 
     # Own slot: local copies (overlap with the DMA traffic).
     for p in range(n_payloads):
         common.local_copy(sends_in[p].at[me], recvs_out[p].at[me], copy_sem)
-    common.local_copy(counts_ref.at[pl.ds(me, 1)],
-                      rcounts_ref.at[pl.ds(me, 1)], copy_sem)
+    common.local_copy(counts_ref.at[me], rcounts_ref.at[me], copy_sem)
 
     for i in range(world - 1):
         src = jax.lax.rem(me + 1 + i, world)
         for p in range(n_payloads):
             common.wait_recv(recvs_out[p].at[src], pay_sems[p].at[world - 1 + src])
-        common.wait_recv(rcounts_ref.at[pl.ds(src, 1)],
-                         cnt_sems.at[world - 1 + src])
+        common.wait_recv(rcounts_ref.at[src], cnt_sems.at[world - 1 + src])
     for dma in dmas:
         dma.wait_send()
 
@@ -128,15 +126,22 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
             raise ValueError(f"payload {pay.shape} != (world={world}, "
                              f"capacity={ctx.capacity}, ...)")
     n = len(payloads)
+    # Counts ride in a tile-aligned (world, 8, 128) block (value at
+    # [:, 0, 0]): Mosaic DMA slices must be tiling-aligned, and a 1-element
+    # slice of a (world,) vector is not ("Slice shape along dimension 0 must
+    # be aligned to tiling (128)"); per-peer [p] indexing of the 3-D block
+    # transfers a full (8, 128) tile. 4KB/peer — noise next to the payloads.
+    counts_block = jnp.zeros((world, 8, 128), jnp.int32
+                             ).at[:, 0, 0].set(send_counts)
     result = pl.pallas_call(
         functools.partial(_a2a_kernel, axis=ctx.axis, world=world,
                           n_payloads=n),
         out_shape=(
             tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in payloads)
-            + (jax.ShapeDtypeStruct((world,), jnp.int32),)
+            + (jax.ShapeDtypeStruct((world, 8, 128), jnp.int32),)
         ),
         in_specs=[common.any_spec()] * (n + 1),
-        out_specs=tuple([common.any_spec()] * (n + 1)),
+        out_specs=tuple([common.hbm_spec()] * (n + 1)),
         scratch_shapes=(
             [common.dma_sems(2 * world - 1) for _ in range(n)]
             + [common.dma_sems(2 * world - 1), pltpu.SemaphoreType.DMA(())]
@@ -144,8 +149,9 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
         compiler_params=common.compiler_params(
             common.collective_id_for(f"ep_a2a_{direction}")),
         interpret=resolve_interpret(interpret),
-    )(*payloads, send_counts)
-    *out, rcounts = result
+    )(*payloads, counts_block)
+    *out, rcounts_block = result
+    rcounts = rcounts_block[:, 0, 0]
     return (out[0] if single else tuple(out)), rcounts
 
 

@@ -43,12 +43,30 @@ class GEMMRSConfig:
     """Tile configuration (analog of ``ReduceScatter2DContext`` block sizes,
     reduce_scatter.py:45)."""
 
-    block_n: int = 256
+    block_n: int | None = None
 
     def n_tiles(self, n: int) -> int:
-        if n % self.block_n:
+        if self.block_n is None or n % self.block_n:
             raise ValueError(f"N {n} not divisible by block_n {self.block_n}")
         return n // self.block_n
+
+    def resolve(self, m: int, k_local: int, n: int, in_itemsize: int,
+                out_itemsize: int) -> "GEMMRSConfig":
+        """``block_n=None`` -> largest lane-aligned divisor of ``n`` whose
+        VMEM working set (A rows + double-buffered B tile + send/acc/tmp/out
+        tiles) fits Mosaic's scoped budget (see allgather_gemm)."""
+        if self.block_n is not None:
+            return self
+
+        def vmem(bn: int) -> int:
+            return (m * k_local * in_itemsize          # a_vmem
+                    + 2 * k_local * bn * in_itemsize   # B tile (dbl-buffered)
+                    + 2 * m * bn * out_itemsize        # send parity slots
+                    + m * bn * 4                       # fp32 accumulator
+                    + 2 * m * bn * out_itemsize)       # tmp + cast-out
+
+        return GEMMRSConfig(block_n=common.choose_lane_block(
+            n, vmem, f"gemm_rs block_n (A rows {m}x{k_local})"))
 
 
 def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
@@ -154,12 +172,18 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
     if M % world:
         raise ValueError(f"M {M} not divisible by world {world}")
     m = M // world
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+    config = config.resolve(m, k_local, n, a_local.dtype.itemsize,
+                            out_dtype.itemsize)
     n_tiles = config.n_tiles(n)
     bn = config.block_n
-    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
 
+    # Incoming-partials staging is an ANY-space OUTPUT (discarded): Mosaic
+    # does not allocate HBM scratch, and peer pushes need a stable HBM buffer
+    # on every device — kernel arg order is unchanged (first-scratch ->
+    # last-output position).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(world, n_tiles),
@@ -167,9 +191,11 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
             pl.BlockSpec(memory_space=pl.ANY),                    # a_local
             pl.BlockSpec((k_local, bn), lambda s, j, me_ref: (0, j)),
         ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),              # (m, N)
+        out_specs=[
+            common.hbm_spec(),                                    # (m, N)
+            common.hbm_spec(),                                    # staging
+        ],
         scratch_shapes=[
-            pltpu.HBM((world - 1, m, n), out_dtype),  # incoming partials
             pltpu.VMEM((m, k_local), a_local.dtype),  # dst-segment A rows
             pltpu.VMEM((2, m, bn), out_dtype),        # per-tile send buffer
             pltpu.VMEM((m, bn), jnp.float32),         # own-tile accumulator
@@ -180,15 +206,19 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    return pl.pallas_call(
+    out, _ = pl.pallas_call(
         functools.partial(_gemm_rs_kernel, axis=axis, world=world,
                           n_tiles=n_tiles, bn=bn),
-        out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((m, n), out_dtype),
+            jax.ShapeDtypeStruct((world - 1, m, n), out_dtype),
+        ],
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("gemm_rs")),
         interpret=resolve_interpret(interpret),
     )(me, a_local, b_local)
+    return out
 
 
 def gemm_rs(a, b, *, mesh: Mesh | None = None, axis: str = "tp",

@@ -118,15 +118,16 @@ def ll_all_gather_device(x_local, staging, epoch, *, axis: str = "tp",
             common.any_spec(),
             common.any_spec(),
         ],
-        out_specs=[common.any_spec(), common.any_spec()],
+        out_specs=[common.hbm_spec(), common.hbm_spec()],
         input_output_aliases={2: 1},
         scratch_shapes=[
             common.dma_sems(world - 1),
             common.dma_sems((2, world)),
             pltpu.SemaphoreType.DMA(()),
         ],
-        compiler_params=common.compiler_params(
-            common.collective_id_for("ag_ll")),
+        # No barrier semaphore is ever touched (that is the LL protocol's
+        # point), so no collective_id (Mosaic rejects an unused one).
+        compiler_params=common.compiler_params(None),
         interpret=resolve_interpret(interpret),
     )(p, x_local, staging)
     return out, staging

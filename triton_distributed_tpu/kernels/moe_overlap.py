@@ -177,13 +177,17 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
             pl.BlockSpec(memory_space=pl.ANY),                # local grid
             pl.BlockSpec((1, bk, bf), lambda s, e, j, kk, me_ref: (e, kk, j)),
         ],
-        out_specs=pl.BlockSpec(
-            (1, capacity, bf),
-            lambda s, e, j, kk, me_ref:
-                (e, jax.lax.rem(me_ref[0] + s, world), j),
-        ),
+        out_specs=[
+            pl.BlockSpec(
+                (1, capacity, bf),
+                lambda s, e, j, kk, me_ref:
+                    (e, jax.lax.rem(me_ref[0] + s, world), j),
+            ),
+            # Remote-arrival staging: HBM OUTPUT (discarded) — Mosaic
+            # has no HBM scratch; arg order unchanged.
+            common.hbm_spec(),
+        ],
         scratch_shapes=[
-            pltpu.HBM((world - 1, E, capacity, d), x_local.dtype),
             pltpu.VMEM((capacity, bk), x_local.dtype),
             pltpu.VMEM((capacity, bf), jnp.float32),
             common.dma_sems(world - 1),
@@ -191,11 +195,13 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    up = pl.pallas_call(
+    up, _ = pl.pallas_call(
         functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
                           n_e=E, n_f=n_f, n_k=n_k, bk=bk),
-        out_shape=jax.ShapeDtypeStruct((E, world * capacity, f_local),
-                                       out_dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((E, world * capacity, f_local), out_dtype),
+            jax.ShapeDtypeStruct((world - 1, E, capacity, d), x_local.dtype),
+        ],
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("ag_group_gemm")),
@@ -321,9 +327,12 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
             pl.BlockSpec(memory_space=pl.ANY),               # act
             pl.BlockSpec((1, bk, bd), lambda s, e, j, kk, me_ref: (e, kk, j)),
         ],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),         # (E, cap, d)
+        out_specs=[
+            common.hbm_spec(),                               # (E, cap, d)
+            # Incoming-partials staging: HBM OUTPUT (discarded).
+            common.hbm_spec(),
+        ],
         scratch_shapes=[
-            pltpu.HBM((world - 1, E, capacity, d), out_dtype),  # partials
             pltpu.VMEM((capacity, bk), act.dtype),           # dst row tile
             pltpu.VMEM((2, capacity, bd), out_dtype),        # send buffer
             pltpu.VMEM((capacity, bd), jnp.float32),         # k-accumulator
@@ -335,16 +344,20 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    return pl.pallas_call(
+    out, _ = pl.pallas_call(
         functools.partial(_group_gemm_rs_kernel, axis=axis, world=world,
                           n_e=E, n_d=n_d, n_k=n_k, bd=bd, bk=bk,
                           cap=capacity),
-        out_shape=jax.ShapeDtypeStruct((E, capacity, d), out_dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((E, capacity, d), out_dtype),
+            jax.ShapeDtypeStruct((world - 1, E, capacity, d), out_dtype),
+        ],
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("moe_reduce_rs")),
         interpret=resolve_interpret(interpret),
     )(me, act, w_down_local)
+    return out
 
 
 # ---------------------------------------------------------------------------

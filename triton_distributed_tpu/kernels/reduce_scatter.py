@@ -132,12 +132,15 @@ def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
     rest = x_local.shape[1:]
     br = common.stage_row_tile(m, rest, x_local.dtype.itemsize)
     oneshot = n_staging_key == "oneshot"
-    scratch = [
-        pltpu.HBM((world - 1, m, *rest), x_local.dtype),   # remote arrivals
-    ]
+    # HBM staging buffers are ANY-space OUTPUTS (discarded): Mosaic does not
+    # allocate HBM scratch, and remote DMAs need stable per-device HBM
+    # buffers — kernel arg order is unchanged (leading-scratch ->
+    # trailing-output positions).
+    out_shape = [jax.ShapeDtypeStruct((m, *rest), x_local.dtype),
+                 jax.ShapeDtypeStruct((world - 1, m, *rest), x_local.dtype)]
     if not oneshot:
-        scratch.append(pltpu.HBM((m, *rest), x_local.dtype))  # ring send
-    scratch += [
+        out_shape.append(jax.ShapeDtypeStruct((m, *rest), x_local.dtype))
+    scratch = [
         common.dma_sems(world),                            # send
         common.dma_sems(world),                            # recv
         pltpu.SemaphoreType.DMA(()),                       # local copies
@@ -147,13 +150,13 @@ def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
     ]
     return common.make_pallas_call(
         functools.partial(kernel, axis=axis, world=world, br=br),
-        out_shape=jax.ShapeDtypeStruct((m, *rest), x_local.dtype),
+        out_shape=out_shape,
         in_specs=[common.any_spec()],
-        out_specs=common.any_spec(),
+        out_specs=[common.hbm_spec()] * len(out_shape),
         scratch_shapes=scratch,
         collective_id=collective_id,
         interpret=interpret,
-    )(x_local)
+    )(x_local)[0]
 
 
 def oneshot_reduce_scatter(x_local, *, axis: str = "tp", interpret=None):

@@ -130,14 +130,19 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
     m_kv = k_local.shape[1]
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    # Gathered-KV staging buffers are ANY-space OUTPUTS (discarded): Mosaic
+    # has no HBM scratch; kernel arg order unchanged (leading-scratch ->
+    # trailing-output positions).
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(H, world),
         in_specs=[common.any_spec()] * 3,
-        out_specs=pl.BlockSpec((1, m, dh), lambda h, s, me_ref: (h, 0, 0)),
+        out_specs=[
+            pl.BlockSpec((1, m, dh), lambda h, s, me_ref: (h, 0, 0)),
+            common.hbm_spec(),
+            common.hbm_spec(),
+        ],
         scratch_shapes=[
-            pltpu.HBM((world, H, m_kv, dh), k_local.dtype),
-            pltpu.HBM((world, H, m_kv, dh), v_local.dtype),
             pltpu.VMEM((m, dh), q_local.dtype),
             pltpu.VMEM((m_kv, dh), k_local.dtype),
             pltpu.VMEM((m_kv, dh), v_local.dtype),
@@ -149,15 +154,20 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    return pl.pallas_call(
+    out, _, _ = pl.pallas_call(
         functools.partial(_sp_attn_kernel, axis=axis, world=world,
                           causal=causal, scale=scale),
-        out_shape=jax.ShapeDtypeStruct((H, m, dh), q_local.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((H, m, dh), q_local.dtype),
+            jax.ShapeDtypeStruct((world, H, m_kv, dh), k_local.dtype),
+            jax.ShapeDtypeStruct((world, H, m_kv, dh), v_local.dtype),
+        ],
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("sp_ag_attn")),
         interpret=resolve_interpret(interpret),
     )(me, q_local, k_local, v_local)
+    return out
 
 
 def _single_device_attn(q, k, v, *, causal: bool, scale: float):
@@ -311,6 +321,14 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
     return out.reshape(B, Hq, dh), lse.reshape(B, Hq)
 
 
+def decode_partial_feat(dh: int) -> int:
+    """Feature width of the packed (out, lse) decode-partial rows exchanged
+    between ranks: dh + 1 rounded up to a lane multiple (128) — callers
+    sizing LL staging for the partial exchange (``make_ll_staging``) must
+    use this width."""
+    return ((dh + 1 + 127) // 128) * 128
+
+
 def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
                         kv_len=None, scale: float | None = None,
                         ll_staging=None, ll_epoch=None, interpret=None):
@@ -339,9 +357,19 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
         out = out_local.astype(q.dtype)
         return (out, ll_staging) if ll_staging is not None else out
 
-    # Pack (out, lse) rows; gather all ranks' partials over ICI.
+    # Pack (out, lse) rows; gather all ranks' partials over ICI. The packed
+    # feature dim is padded to a lane multiple: Mosaic DMA slices must be
+    # 128-aligned and dh+1 is not (the compiled ring kernel rejected 129).
+    feat = decode_partial_feat(dh)
+    if ll_staging is not None and ll_staging.shape[-1] != feat:
+        raise ValueError(
+            f"ll_staging feature width {ll_staging.shape[-1]} != "
+            f"decode_partial_feat({dh}) = {feat}; size the staging as "
+            f"make_ll_staging((B*H, decode_partial_feat(dh)), ...) — the "
+            f"packed (out, lse) rows are lane-padded")
     packed = jnp.concatenate(
-        [out_local.reshape(B * H, dh), lse_local.reshape(B * H, 1)], axis=-1)
+        [out_local.reshape(B * H, dh), lse_local.reshape(B * H, 1),
+         jnp.zeros((B * H, feat - dh - 1), out_local.dtype)], axis=-1)
     if ll_staging is not None:
         from triton_distributed_tpu.kernels.ll_allgather import (
             ll_all_gather_device,
@@ -351,7 +379,7 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
             packed, ll_staging, ll_epoch, axis=axis, interpret=interpret)
     else:
         gathered = ring_all_gather(packed, axis=axis, interpret=interpret)
-    gathered = gathered.reshape(world, B, H, dh + 1)
+    gathered = gathered.reshape(world, B, H, feat)
     outs, lses = gathered[..., :dh], gathered[..., dh]     # (w,B,H,dh), (w,B,H)
 
     # LSE merge: softmax over ranks weights each partial.

@@ -47,7 +47,10 @@ class SpGQAFlashDecodeAttention:
         ``ll_staging``/``ll_epoch`` route the partial exchange over the
         low-latency allgather (the decode-loop fast path; the reference's
         adaptive symm buffer, sp_flash_decode_layer.py:116) — the return
-        becomes (out, staging) to thread into the next decode step."""
+        becomes (out, staging) to thread into the next decode step. Size
+        the staging ``make_ll_staging((B * Hq, decode_partial_feat(dh)),
+        jnp.float32, ...)`` — packed partial rows are lane-padded
+        (kernels.sp_attention.decode_partial_feat)."""
         local_len = None
         if kv_len is not None:
             m_kv = k_cache_local.shape[2]

@@ -35,7 +35,12 @@ class Engine:
                  temperature: float = 0.0, top_p: float = 1.0,
                  params=None, key=None, hf_path: str | None = None,
                  block_n: int = 256, max_length: int | None = None,
-                 interpret=None):
+                 aot_cache: bool = False, interpret=None):
+        """``aot_cache=True`` routes step compilation through the serialized
+        AOT executable cache (``tools.aot.AOTExecutableCache``): later
+        process starts deserialize the step executable instead of
+        re-tracing + re-compiling — the reference's AOT kernel library
+        cutting engine cold-start (tools/compile_aot.py:470)."""
         self.config = config
         self.mesh = mesh or get_default_mesh()
         self.model = Qwen3(config, block_n=block_n)
@@ -53,6 +58,12 @@ class Engine:
             self.params = self.model.init(
                 jax.random.PRNGKey(0) if key is None else key, self.mesh)
         self._steps: dict[str, object] = {}
+        self._aot = None
+        if aot_cache:
+            from triton_distributed_tpu.tools.aot import AOTExecutableCache
+
+            self._aot = AOTExecutableCache()
+        self._aot_steps: dict[tuple, object] = {}
 
     # -- compiled step ------------------------------------------------------
 
@@ -81,6 +92,20 @@ class Engine:
         self._steps[mode] = step
         return step
 
+    def _run_step(self, mode: str, ids, kv: KVCache):
+        step = self._step_fn(mode)
+        if self._aot is None:
+            return step(self.params, ids, kv)
+        key = (mode, ids.shape, kv.k.shape)
+        if key not in self._aot_steps:
+            abstract = jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                (self.params, ids, kv))
+            self._aot_steps[key], _ = self._aot.load_or_compile(
+                f"engine_step_{self.config.model_name}_{mode}", step, *abstract,
+                mesh=self.mesh)
+        return self._aot_steps[key](self.params, ids, kv)
+
     # -- public API ---------------------------------------------------------
 
     def new_cache(self, batch_size: int) -> KVCache:
@@ -90,12 +115,11 @@ class Engine:
 
     def prefill(self, input_ids, kv: KVCache):
         """input_ids: (B, L) -> (logits (B, V), kv)."""
-        return self._step_fn(self.prefill_mode)(self.params, input_ids, kv)
+        return self._run_step(self.prefill_mode, input_ids, kv)
 
     def decode_step(self, token, kv: KVCache):
         """token: (B,) -> (logits (B, V), kv)."""
-        return self._step_fn(self.decode_mode)(
-            self.params, token[:, None], kv)
+        return self._run_step(self.decode_mode, token[:, None], kv)
 
     def serve(self, input_ids, gen_len: int, key=None):
         """Generate ``gen_len`` tokens after the prompt.

@@ -1,0 +1,27 @@
+"""Tooling layer: AOT compilation, autotuning, profiling.
+
+TPU-native analog of the reference's ``python/triton_dist/tools/`` (AOT
+compile toolchain ``compile_aot.py``:61 — per-config compile spaces, C
+library link, runtime loader) and its autotuner/profiler utilities. Here:
+
+- ``tools.aot`` — Mosaic AOT compilation of the distributed Pallas kernels
+  against a TPU *topology descriptor* (no devices needed) at production
+  shapes, plus a serialized-executable cache that cuts engine cold-start
+  (``jax.jit(...).lower().compile()`` + ``serialize_executable``, the
+  ``lib<...>_kernel.so`` analog).
+- ``tools.autotuner`` — re-export of the contextual autotuner
+  (``runtime/autotuner.py``).
+- ``group_profile`` — per-host profiler context (``runtime/utils.py``).
+"""
+
+from triton_distributed_tpu.runtime.autotuner import (  # noqa: F401
+    ContextualAutotuner,
+    contextual_autotune,
+)
+from triton_distributed_tpu.runtime.utils import group_profile  # noqa: F401
+from triton_distributed_tpu.tools.aot import (  # noqa: F401
+    AOTExecutableCache,
+    FLAGSHIP_SPECS,
+    aot_compile_flagship,
+    topology_mesh,
+)
