@@ -108,3 +108,32 @@ def test_world1_ragged_k_delegates_not_raises(rng):
                     golden)
     assert_allclose(run(lambda al, bl: gemm_rs_device(al, bl, axis="tp")),
                     golden)
+
+
+def test_fused_matmul_step(rng):
+    """c + a @ (b + s) fused in one kernel with c donated (the bench arm /
+    k-split accumulation building block)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import fused_matmul_step
+
+    M, K, N = 16, 256, 128
+    a, b = _ab(rng, M, K, N)
+    c = jnp.asarray(rng.standard_normal((M, N), dtype=np.float32))
+    for bk in (None, 128):
+        got = jax.jit(lambda c, a, b, bk=bk: fused_matmul_step(
+            c, a, b, 0.75, block_m=8, block_n=128, block_k=bk))(c, a, b)
+        golden = (np.asarray(c) +
+                  np.asarray(a) @ (np.asarray(b) + np.float32(0.75)))
+        assert got.dtype == jnp.float32
+        assert_allclose(got, golden)
+
+
+def test_ag_gemm_loopback(rng):
+    """Self-loopback overlap kernel (staging + per-segment DMA waits +
+    segment grid on one device) computes a plain matmul."""
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_loopback
+
+    M, K, N = 64, 32, 128
+    a, b = _ab(rng, M, K, N)
+    got = jax.jit(lambda a, b: ag_gemm_loopback(
+        a, b, segments=8, config=AGGEMMConfig(block_n=128)))(a, b)
+    assert_allclose(got, np.asarray(a) @ np.asarray(b))

@@ -239,6 +239,33 @@ MATMUL_BLOCK_CANDIDATES: tuple[tuple[int, int, int], ...] = (
 )
 
 
+def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
+                        n: int, dtype_str: str):
+    """Shared (m, k, n) block-tuning harness: time an 8x in-jit fori_loop of
+    ``body_of(cfg)(acc, a, b)`` (forced dependence through acc defeats
+    hoisting) per candidate config; contextual-autotuner cached."""
+    tuner = ContextualAutotuner(name, list(candidates), iters=(2, 6))
+    dtype = jnp.dtype(dtype_str)
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (m, k), dtype)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype)
+
+    def make_thunk(cfg):
+        body = body_of(cfg)
+
+        @jax.jit
+        def loop(a, b):
+            return jax.lax.fori_loop(
+                0, 8, lambda _, acc: body(acc, a, b),
+                jnp.zeros((m, n), jnp.float32))
+
+        loop(a, b).block_until_ready()  # compile check before timing
+        return lambda: loop(a, b)
+
+    return tuner.tune(make_thunk, f"{m}x{k}x{n}:{dtype_str}:"
+                                  f"{jax.devices()[0].device_kind}")
+
+
 @functools.lru_cache(maxsize=None)
 def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
     """On-chip tune of the single-chip matmul blocks at (m, k, n) — the
@@ -253,31 +280,52 @@ def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
                 and k % min(c[2], k) == 0]
     if not feasible:
         feasible = [(min(1024, m), min(640, n), min(1024, k))]
-    # The thunk loops 8x in-jit already; small host iters just cancel the
-    # dispatch overhead in the slope.
-    tuner = ContextualAutotuner("matmul_blocks", feasible, iters=(2, 6))
 
-    dtype = jnp.dtype(dtype_str)
-    key = jax.random.PRNGKey(0)
-    a = jax.random.normal(key, (m, k), dtype)
-    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), dtype)
-
-    def make_thunk(cfg):
+    def body_of(cfg):
         bm, bn, bk = (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))
 
-        @jax.jit
-        def loop(a, b):
-            def body(_, acc):
-                bb = b + (acc[0, 0] * 0).astype(b.dtype)
-                return acc + ag_gemm_single_chip(
-                    a, bb, block_m=bm, block_n=bn, block_k=bk
-                ).astype(jnp.float32)
-            return jax.lax.fori_loop(
-                0, 8, body, jnp.zeros((m, n), jnp.float32))
+        def body(acc, a, b):
+            bb = b + (acc[0, 0] * 0).astype(b.dtype)
+            return acc + ag_gemm_single_chip(
+                a, bb, block_m=bm, block_n=bn, block_k=bk
+            ).astype(jnp.float32)
+        return body
 
-        loop(a, b).block_until_ready()  # compile check before timing
-        return lambda: loop(a, b)
-
-    cfg = tuner.tune(make_thunk, f"{m}x{k}x{n}:{dtype_str}:"
-                                 f"{jax.devices()[0].device_kind}")
+    cfg = _tune_matmul_blocks("matmul_blocks", feasible, body_of, m, k, n,
+                              dtype_str)
     return (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))
+
+
+# Fused accumulate-step candidates ((bm, bn, bk); bk=None = full K single
+# pass). Full-K (512, 640) is the on-chip winner at the bench shape
+# (0.707 ms vs XLA 0.725, 4096x5120x3200 bf16); the rest cover revisiting
+# variants and smaller shapes.
+FUSED_STEP_CANDIDATES: tuple[tuple[int, int, int | None], ...] = (
+    (512, 640, None),
+    (1024, 640, 2560),
+    (512, 640, 2560),
+    (1024, 640, 1024),
+    (256, 640, None),
+)
+
+
+@functools.lru_cache(maxsize=None)
+def tuned_fused_step_blocks(m: int, k: int, n: int,
+                            dtype_str: str = "bfloat16"):
+    """On-chip tune of ``fused_matmul_step`` blocks at (m, k, n):
+    returns (bm, bn, bk|None); cached in memory and on disk."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        fused_matmul_step,
+    )
+
+    def body_of(cfg):
+        bm, bn, bk = cfg
+
+        def body(acc, a, b):
+            s = (acc[0, 0] * 0).astype(jnp.float32)
+            return fused_matmul_step(acc, a, b, s, block_m=bm, block_n=bn,
+                                     block_k=bk)
+        return body
+
+    return _tune_matmul_blocks("fused_step_blocks", FUSED_STEP_CANDIDATES,
+                               body_of, m, k, n, dtype_str)
