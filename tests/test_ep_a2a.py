@@ -21,17 +21,37 @@ WORLD = 8
 
 
 def test_all_to_all_routes_blocks(mesh8, rng):
-    cap, hidden = 8, 16
-    ctx = AllToAllContext(capacity=cap, hidden=hidden, axis="tp")
+    """Valid rows route correctly AND bytes moved scale with occupancy:
+    rows beyond each slot's sent chunks are untouched receiver memory
+    (NaN under the interpreter's uninitialized_memory fill) — the dispatch
+    moves ~splits[p] tokens, not capacity, per peer (reference exact-split
+    sends, low_latency_all_to_all.py:36)."""
+    cap, hidden = 16, 16
+    ctx = AllToAllContext(capacity=cap, hidden=hidden, axis="tp",
+                          chunk_rows=8)
     toks = jnp.asarray(
         rng.standard_normal((WORLD, WORLD, cap, hidden), dtype=np.float32))
     counts = jnp.tile(jnp.arange(WORLD, dtype=jnp.int32)[None, :], (WORLD, 1))
     out, rcounts = all_to_all(toks, counts, ctx=ctx, mesh=mesh8)
-    # out[r][p] must equal in[p][r]; rcounts[r][p] = counts[p][r].
+    # out[r][p] must equal in[p][r] on valid rows; rcounts[r][p] =
+    # counts[p][r].
+    out = np.asarray(out)
     expected = np.transpose(np.asarray(toks), (1, 0, 2, 3))
-    assert_allclose(out, expected)
     np.testing.assert_array_equal(
         np.asarray(rcounts), np.asarray(counts).T)
+    for r in range(WORLD):
+        for p in range(WORLD):
+            n = int(np.asarray(rcounts)[r, p])
+            ch = ctx.chunk_rows
+            sent = cap if p == r else min(cap, -(-max(n, 0) // ch) * ch)
+            assert_allclose(out[r, p, :n], expected[r, p, :n],
+                            msg=f"valid rows r={r} p={p}")
+            # Chunked occupancy: remote rows beyond the sent chunks were
+            # never written — still NaN.
+            tail = out[r, p, sent:]
+            assert np.isnan(tail).all(), (
+                f"r={r} p={p}: rows {sent}:{cap} were transferred despite "
+                f"count {n} (full-capacity push)")
 
 
 def test_all_to_all_multi_payload(mesh8, rng):

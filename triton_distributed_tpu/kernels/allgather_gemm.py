@@ -124,7 +124,7 @@ def _ag_gemm_kernel(me_ref, a_ref, b_ref, o_ref, a_full, a_vmem, send_sems,
     @pl.when((s == world - 1) & (j == n_tiles - 1))
     def _drain():
         for i in range(world - 1):
-            common.wait_recv(a_ref, send_sems.at[i])
+            common.wait_send(a_ref, send_sems.at[i])
 
 
 def ag_gemm_device(a_local, b_local, *, axis: str = "tp",

@@ -82,6 +82,7 @@ def local_copy(src_ref, dst_ref, sem):
 # Receiver-side arrival wait; single implementation lives in the language
 # layer (the shmem putmem_signal counterpart).
 from triton_distributed_tpu.language.shmem import wait_dma_arrival as wait_recv  # noqa: E402,F401
+from triton_distributed_tpu.language.shmem import wait_send_bytes as wait_send  # noqa: E402,F401
 
 
 def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):

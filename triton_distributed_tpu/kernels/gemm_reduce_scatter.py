@@ -101,7 +101,7 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
     # parity) must have locally drained.
     @pl.when(~is_own & (t >= 2))
     def _reclaim():
-        common.wait_recv(send_tile.at[parity], send_sems.at[parity])
+        common.wait_send(send_tile.at[parity], send_sems.at[parity])
 
     partial = jnp.dot(a_vmem[...], b_ref[...],
                       preferred_element_type=jnp.float32)
@@ -151,7 +151,7 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
         @pl.when(j == n_tiles - 1)
         def _drain():
             for p in range(min(2, total_remote)):
-                common.wait_recv(send_tile.at[p], send_sems.at[p])
+                common.wait_send(send_tile.at[p], send_sems.at[p])
 
 
 def gemm_rs_device(a_local, b_local, *, axis: str = "tp",

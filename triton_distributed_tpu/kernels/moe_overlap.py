@@ -131,7 +131,7 @@ def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
              & (kk == n_k - 1))
     def _drain():
         for i in range(world - 1):
-            common.wait_recv(x_ref, send_sems.at[i])
+            common.wait_send(x_ref, send_sems.at[i])
 
 
 def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
@@ -251,7 +251,7 @@ def _group_gemm_rs_kernel(me_ref, a_ref, w_ref, o_ref, staging, a_vmem,
 
     @pl.when(~is_own & is_last_k & (t >= 2))
     def _reclaim():
-        common.wait_recv(send_tile.at[parity], send_sems.at[parity])
+        common.wait_send(send_tile.at[parity], send_sems.at[parity])
 
     @pl.when(~is_own & is_last_k)
     def _push_tile():
@@ -291,7 +291,7 @@ def _group_gemm_rs_kernel(me_ref, a_ref, w_ref, o_ref, staging, a_vmem,
         @pl.when((e == n_e - 1) & (j == n_d - 1))
         def _drain():
             for p in range(min(2, total_remote)):
-                common.wait_recv(send_tile.at[p], send_sems.at[p])
+                common.wait_send(send_tile.at[p], send_sems.at[p])
 
 
 def group_gemm_rs_device(act, w_down_local, *, capacity: int,

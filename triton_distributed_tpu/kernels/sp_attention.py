@@ -109,8 +109,8 @@ def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
     @pl.when((h == pl.num_programs(0) - 1) & (s == world - 1))
     def _drain():
         for i in range(world - 1):
-            common.wait_recv(k_ref, send_sems.at[2 * i])
-            common.wait_recv(v_ref, send_sems.at[2 * i + 1])
+            common.wait_send(k_ref, send_sems.at[2 * i])
+            common.wait_send(v_ref, send_sems.at[2 * i + 1])
 
 
 def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",

@@ -102,6 +102,15 @@ def wait_dma_arrival(dst_ref, recv_sem):
     pltpu.make_async_copy(dst_ref, dst_ref, recv_sem).wait()
 
 
+def wait_send_bytes(src_ref, send_sem):
+    """Block until DMAs totalling ``src_ref``'s byte count have locally
+    drained from ``send_sem`` — the sender-side counterpart of
+    ``wait_dma_arrival`` for draining predicated/accumulated pushes whose
+    descriptors are no longer in scope (kernels that re-derive the drain
+    condition instead of carrying handles)."""
+    pltpu.make_async_copy(src_ref, src_ref, send_sem).wait()
+
+
 def quiet(*dmas):
     """Wait for local completion of the given outstanding puts
     (``nvshmem_quiet`` analog, scoped to explicit handles)."""

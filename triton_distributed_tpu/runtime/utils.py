@@ -83,9 +83,16 @@ def assert_allclose(actual, expected, *, atol=None, rtol=None, msg=""):
         raise AssertionError(f"shape mismatch {actual.shape} vs {expected.shape} {msg}")
     err = np.abs(actual - expected)
     bound = atol + rtol * np.abs(expected)
-    bad = err > bound
+    # NaN-strict: ``err > bound`` is False for NaN, which would silently
+    # pass a NaN-vs-number mismatch (this masked uninitialized-memory reads
+    # in r3). Both-NaN counts as equal; one-sided NaN fails.
+    both_nan = np.isnan(actual) & np.isnan(expected)
+    bad = ~((err <= bound) | both_nan)
     if bad.any():
-        idx = np.unravel_index(np.argmax(err - bound), err.shape)
+        # Rank violations only among failing elements (err - bound is NaN at
+        # both-NaN positions and would win a plain argmax).
+        score = np.where(bad, np.nan_to_num(err - bound, nan=np.inf), -np.inf)
+        idx = np.unravel_index(np.argmax(score), err.shape)
         raise AssertionError(
             f"allclose failed {msg}: {bad.sum()}/{bad.size} elements "
             f"(worst at {idx}: got {actual[idx]}, want {expected[idx]}, "
