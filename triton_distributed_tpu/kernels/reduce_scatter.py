@@ -201,7 +201,14 @@ def reduce_scatter(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
         if dcn_axis and mesh.shape.get(dcn_axis, 1) > 1:
             method = "ring_2d"
         else:
-            method = ("oneshot" if x_stacked.nbytes // world <= (1 << 22)
+            # Model-driven crossover (runtime/perf_model.py): one-shot wins
+            # on latency for small contributions, the ring on bandwidth.
+            from triton_distributed_tpu.runtime import perf_model as pm
+
+            per_dev = x_stacked.nbytes // world
+            method = ("oneshot"
+                      if pm.est_oneshot_reduce_scatter(per_dev, world)
+                      <= pm.est_ring_reduce_scatter(per_dev, world)
                       else "ring")
     if method == "ring_2d":
         if dcn_axis is None:

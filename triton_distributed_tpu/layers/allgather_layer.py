@@ -23,9 +23,17 @@ from triton_distributed_tpu.kernels.ll_allgather import (
 )
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
-# LL staging pays off below roughly the same size the a2a/ring crossover
-# uses; decode messages are typically a few hundred KB.
-_LL_MAX_BYTES = 1 << 20
+def _ll_wins(world: int, nbytes: int) -> bool:
+    """LL vs the best stateless method by the analytic model
+    (runtime/perf_model.py): LL drops the entry barrier but pays a
+    staging->output copy, so decode-size messages win and large transfers
+    fall back to the ring."""
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    ll = pm.est_ll_all_gather(nbytes, world)
+    best = min(pm.est_push_all_gather(nbytes, world),
+               pm.est_ring_all_gather(nbytes, world))
+    return ll <= best
 
 _instance_counter = 0
 
@@ -93,7 +101,7 @@ class AllGatherLayer:
         nbytes = x_local.nbytes if hasattr(x_local, "nbytes") else 0
         if method is AllGatherMethod.AUTO:
             if (staging is not None and epoch is not None
-                    and nbytes <= _LL_MAX_BYTES):
+                    and _ll_wins(world, nbytes)):
                 method = AllGatherMethod.LL
             else:
                 method = choose_all_gather_method(world, nbytes)

@@ -31,4 +31,7 @@ from triton_distributed_tpu.runtime.utils import (  # noqa: F401
     perf_func,
     dist_print,
     assert_allclose,
+    group_profile,
+    straggler_delay,
 )
+from triton_distributed_tpu.runtime import perf_model  # noqa: F401

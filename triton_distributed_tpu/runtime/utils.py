@@ -118,3 +118,25 @@ def group_profile(name: str = "trace", *, enabled: bool = True, dir: str = "/tmp
         yield
     finally:
         jax.profiler.stop_trace()
+
+
+def straggler_delay(x, steps, *, size: int = 8):
+    """Inject a per-device compute delay before ``x`` is consumed — the
+    straggler-simulation analog of the reference's ``sleep_async``
+    (utils.py:1010) and ``_run_straggler`` (kernels/nvidia/allreduce.py:146),
+    used by the stress harness to prove the overlap kernels tolerate skew.
+
+    ``steps`` dummy (size, size) matmul iterations run on this device (pass
+    e.g. ``axis_index * k`` for rank-proportional skew); the result is folded
+    into ``x`` as a zero-valued data dependence so the delay cannot be
+    hoisted or elided."""
+    seed = jnp.full((size, size), 0.999, jnp.float32)
+
+    def body(_, acc):
+        acc = jnp.dot(acc, acc, preferred_element_type=jnp.float32)
+        # Renormalize: an unbounded power chain overflows to inf and
+        # inf * 0 would fold NaN into x.
+        return acc / jnp.maximum(jnp.max(jnp.abs(acc)), 1e-30)
+
+    d = jax.lax.fori_loop(0, jnp.asarray(steps, jnp.int32), body, seed)
+    return x + (d[0, 0] * 0).astype(x.dtype)
