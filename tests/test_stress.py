@@ -14,15 +14,12 @@ interpreter's vector-clock data-race detector (runtime/platform.py).
 Shapes honor the conftest interpreter per-buffer ceiling (<=12KB).
 """
 
-import functools
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
-from triton_distributed_tpu.runtime.platform import resolve_interpret
 from triton_distributed_tpu.runtime.utils import straggler_delay
 
 WORLD = 8
@@ -172,11 +169,11 @@ def test_stress_ll_allgather_epochs_with_stragglers(mesh8):
             rtol=1e-6)
 
 
-def test_collectives_race_detect(mesh8):
+def test_collectives_race_detect(mesh8, capfd):
     """One pass of the collective set under the interpreter's vector-clock
     race detector (InterpretParams(detect_races=True)) — the
-    compute-sanitizer analog. A detected race raises/asserts inside the
-    interpreter."""
+    compute-sanitizer analog. The detector PRINTS "RACE DETECTED" (it does
+    not raise), so the assertion is on captured output."""
     from jax.experimental.pallas import tpu as pltpu
 
     from triton_distributed_tpu.kernels.allgather import (
@@ -207,3 +204,6 @@ def test_collectives_race_detect(mesh8):
     ]:
         out = _run8(f, mesh8, P("tp"), out_spec, arg)
         assert np.isfinite(np.asarray(out)).all(), name
+    captured = capfd.readouterr()
+    assert "RACE DETECTED" not in captured.out + captured.err, (
+        captured.out + captured.err)

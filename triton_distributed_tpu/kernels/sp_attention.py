@@ -42,13 +42,25 @@ from triton_distributed_tpu.runtime.platform import resolve_interpret
 _NEG_INF = -1e30
 
 
-def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
-                    q_vmem, k_vmem, v_vmem, acc_ref, m_ref, l_ref,
-                    send_sems, recv_sems, copy_sem, *, axis: str, world: int,
-                    causal: bool, scale: float):
+def _sp_attn_kernel(*refs, axis: str, world: int, causal: bool, scale: float,
+                    partials: bool):
+    # scalars_ref = [me, row0, col0]: row0/col0 are this device's GLOBAL q /
+    # current KV-block column offsets — the 1-D path passes (me*m, 0); the
+    # inter-slice ring passes slice-level offsets so causal masking works on
+    # global positions (reference sp_ag_attention_inter_node.py:115).
+    if partials:
+        (scalars_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, k_full, v_full,
+         q_vmem, k_vmem, v_vmem, acc_ref, m_ref, l_ref,
+         send_sems, recv_sems, copy_sem) = refs
+    else:
+        (scalars_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
+         q_vmem, k_vmem, v_vmem, acc_ref, m_ref, l_ref,
+         send_sems, recv_sems, copy_sem) = refs
     h = pl.program_id(0)
     s = pl.program_id(1)
-    me = me_ref[0]
+    me = scalars_ref[0]
+    row0 = scalars_ref[1]
+    col0 = scalars_ref[2]
     src = jax.lax.rem(me + s, world)  # own shard first, then by distance
 
     @pl.when((h == 0) & (s == 0))
@@ -76,8 +88,12 @@ def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Causal: segment right of the diagonal contributes nothing.
-    needed = (src <= me) if causal else (src == src)
+    # Causal: a segment whose first GLOBAL column is right of this device's
+    # last global row contributes nothing (fully-masked rows inside needed
+    # segments are handled by the `* valid` guard below).
+    m_q = q_vmem.shape[0]
+    m_kv = k_vmem.shape[0]
+    needed = (col0 + src * m_kv <= row0 + m_q - 1) if causal else (src == src)
 
     @pl.when(needed)
     def _segment():
@@ -87,15 +103,23 @@ def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
         scores = jax.lax.dot_general(
             q, k_vmem[...].astype(jnp.float32),
             (((1,), (1,)), ((), ()))) * scale          # (m, m_kv)
+        valid = None
         if causal:
-            m_q, m_kv = scores.shape
-            rows = me * m_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-            cols = src * m_kv + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-            scores = jnp.where(rows >= cols, scores, _NEG_INF)
+            rows = row0 + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+            cols = (col0 + src * m_kv
+                    + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1))
+            valid = rows >= cols
+            scores = jnp.where(valid, scores, _NEG_INF)
         seg_max = jnp.max(scores, axis=1, keepdims=True)
         new_max = jnp.maximum(m_ref[...], seg_max)
         corr = jnp.exp(m_ref[...] - new_max)
         p = jnp.exp(scores - new_max)
+        if valid is not None:
+            # A FULLY-masked q row has scores == new_max == _NEG_INF and
+            # exp(0) == 1 would poison the denominator (the decode kernel's
+            # `* valid` guard) — keeps arbitrary, non-shard-aligned
+            # row/col offsets safe, not just the aligned 1-D/2-D callers.
+            p = p * valid.astype(jnp.float32)
         l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
             p, v_vmem[...].astype(jnp.float32), (((1,), (0,)), ((), ())))
@@ -105,6 +129,11 @@ def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
     def _finish_head():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        if partials:
+            # log-sum-exp, lane-broadcast (column 0 meaningful): a slice
+            # with nothing to attend reports ~-1e30 -> zero merge weight.
+            lse_ref[0] = jnp.broadcast_to(m_ref[...] + jnp.log(denom),
+                                          lse_ref.shape[1:])
 
     @pl.when((h == pl.num_programs(0) - 1) & (s == world - 1))
     def _drain():
@@ -115,33 +144,53 @@ def _sp_attn_kernel(me_ref, q_ref, k_ref, v_ref, o_ref, k_full, v_full,
 
 def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
                            causal: bool = True, scale: float | None = None,
-                           interpret=None):
+                           row_offset=None, col_offset=None,
+                           return_partials: bool = False, interpret=None):
     """Per-device SP prefill attention (composable inside shard_map).
 
     q/k/v_local: (H, m, dh) — the sequence dim sharded over ``axis``.
     Returns (H, m, dh): this device's Q rows attended over the FULL sequence,
-    with the KV allgather overlapped into the attention."""
+    with the KV allgather overlapped into the attention.
+
+    ``row_offset``/``col_offset``: GLOBAL position of this device's first q
+    row / of the KV block's first column (default: the 1-D values
+    ``me * m`` / 0). ``return_partials=True`` additionally returns the
+    per-row log-sum-exp (H, m) — the mergeable-partial form consumed by the
+    inter-slice ring (``sp_ag_attention_2d_device``)."""
     world = jax.lax.axis_size(axis)
     H, m, dh = q_local.shape
     scale = dh ** -0.5 if scale is None else scale
-    if world == 1:
+    if world == 1 and not return_partials and row_offset is None \
+            and col_offset is None:
         return _single_device_attn(q_local, k_local, v_local, causal=causal,
                                    scale=scale)
     m_kv = k_local.shape[1]
 
-    me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    me = jax.lax.axis_index(axis).astype(jnp.int32)
+    row0 = (me * m if row_offset is None
+            else jnp.asarray(row_offset, jnp.int32))
+    col0 = (jnp.zeros((), jnp.int32) if col_offset is None
+            else jnp.asarray(col_offset, jnp.int32))
+    scalars = jnp.stack([me, row0, col0])
     # Gathered-KV staging buffers are ANY-space OUTPUTS (discarded): Mosaic
     # has no HBM scratch; kernel arg order unchanged (leading-scratch ->
     # trailing-output positions).
+    out_specs = [pl.BlockSpec((1, m, dh), lambda h, s, sc: (h, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((H, m, dh), q_local.dtype)]
+    if return_partials:
+        out_specs.append(
+            pl.BlockSpec((1, m, 128), lambda h, s, sc: (h, 0, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((H, m, 128), jnp.float32))
+    out_specs += [common.hbm_spec(), common.hbm_spec()]
+    out_shape += [
+        jax.ShapeDtypeStruct((world, H, m_kv, dh), k_local.dtype),
+        jax.ShapeDtypeStruct((world, H, m_kv, dh), v_local.dtype),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(H, world),
         in_specs=[common.any_spec()] * 3,
-        out_specs=[
-            pl.BlockSpec((1, m, dh), lambda h, s, me_ref: (h, 0, 0)),
-            common.hbm_spec(),
-            common.hbm_spec(),
-        ],
+        out_specs=out_specs,
         scratch_shapes=[
             pltpu.VMEM((m, dh), q_local.dtype),
             pltpu.VMEM((m_kv, dh), k_local.dtype),
@@ -154,20 +203,70 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
             pltpu.SemaphoreType.DMA(()),
         ],
     )
-    out, _, _ = pl.pallas_call(
+    result = pl.pallas_call(
         functools.partial(_sp_attn_kernel, axis=axis, world=world,
-                          causal=causal, scale=scale),
-        out_shape=[
-            jax.ShapeDtypeStruct((H, m, dh), q_local.dtype),
-            jax.ShapeDtypeStruct((world, H, m_kv, dh), k_local.dtype),
-            jax.ShapeDtypeStruct((world, H, m_kv, dh), v_local.dtype),
-        ],
+                          causal=causal, scale=scale,
+                          partials=return_partials),
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("sp_ag_attn")),
         interpret=resolve_interpret(interpret),
-    )(me, q_local, k_local, v_local)
-    return out
+    )(scalars, q_local, k_local, v_local)
+    if return_partials:
+        return result[0], result[1][..., 0]
+    return result[0]
+
+
+def sp_ag_attention_2d_device(q_local, k_local, v_local, *,
+                              ici_axis: str = "sp", dcn_axis: str = "dcn",
+                              causal: bool = True, scale: float | None = None,
+                              interpret=None):
+    """Inter-slice SP prefill attention over a (dcn, ici) mesh — the analog
+    of the reference's ``sp_ag_attention_inter_node.py`` (2D AG push :115,
+    ``fused_sp_ag_attn_inter_node`` :504).
+
+    The sequence is sharded over ALL devices (dcn-major). Intra-slice KV
+    streams through the overlap kernel exactly as the 1-D path; INTER-slice
+    KV arrives via the XLA DCN leg as a slice-level ring
+    (``lax.ppermute`` over ``dcn_axis``) and each arriving slice block is
+    processed immediately — its (out, lse) partial merged by log-sum-exp.
+    XLA schedules the next ppermute concurrently with the current slice's
+    attention kernel (async collective + custom call), so the DCN hop rides
+    under intra-slice compute."""
+    n_slices = jax.lax.axis_size(dcn_axis)
+    w_ici = jax.lax.axis_size(ici_axis)
+    H, m, dh = q_local.shape
+    m_kv = k_local.shape[1]
+    scale = dh ** -0.5 if scale is None else scale
+    sid = jax.lax.axis_index(dcn_axis)
+    me = jax.lax.axis_index(ici_axis)
+    row0 = (sid * w_ici + me) * m
+
+    acc = jnp.zeros((H, m, dh), jnp.float32)
+    mx = jnp.full((H, m, 1), _NEG_INF, jnp.float32)
+    den = jnp.zeros((H, m, 1), jnp.float32)
+    kb, vb = k_local, v_local
+    cur = sid  # slice whose KV block this device currently holds
+    perm = [(i, (i + 1) % n_slices) for i in range(n_slices)]
+    for step in range(n_slices):
+        col0 = cur * w_ici * m_kv
+        out_p, lse_p = sp_ag_attention_device(
+            q_local, kb, vb, axis=ici_axis, causal=causal, scale=scale,
+            row_offset=row0, col_offset=col0, return_partials=True,
+            interpret=interpret)
+        lse = lse_p[..., None]
+        new_mx = jnp.maximum(mx, lse)
+        c_old = jnp.exp(mx - new_mx)
+        c_new = jnp.exp(lse - new_mx)
+        acc = acc * c_old + out_p.astype(jnp.float32) * c_new
+        den = den * c_old + c_new
+        mx = new_mx
+        if step < n_slices - 1:
+            kb = jax.lax.ppermute(kb, dcn_axis, perm)
+            vb = jax.lax.ppermute(vb, dcn_axis, perm)
+            cur = jax.lax.rem(cur - 1 + n_slices, n_slices)
+    return (acc / jnp.maximum(den, 1e-30)).astype(q_local.dtype)
 
 
 def _single_device_attn(q, k, v, *, causal: bool, scale: float):

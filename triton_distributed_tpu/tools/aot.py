@@ -155,6 +155,23 @@ def _spec_sp_attention(mesh):
     return sm, (x, x, x)
 
 
+def _spec_sp_attention_partials(mesh):
+    from triton_distributed_tpu.kernels.sp_attention import sp_ag_attention_device
+
+    H, m, dh = 64, 1024, 128
+
+    def f(q, k, v):
+        out, lse = sp_ag_attention_device(
+            q[0], k[0], v[0], axis="sp", return_partials=True,
+            interpret=False)
+        return out[None], lse[None]
+
+    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
+                       out_specs=(P("sp"), P("sp")), check_vma=False)
+    x = _sds((8, H, m, dh), jnp.bfloat16)
+    return sm, (x, x, x)
+
+
 def _spec_flash_decode(mesh):
     from triton_distributed_tpu.kernels.sp_attention import flash_decode_device
 
@@ -271,6 +288,8 @@ FLAGSHIP_SPECS: dict[str, AOTSpec] = {
         AOTSpec("ag_group_gemm", (("tp", 8),), _spec_ag_group_gemm),
         AOTSpec("group_gemm_rs", (("tp", 8),), _spec_group_gemm_rs),
         AOTSpec("sp_attention", (("sp", 8),), _spec_sp_attention),
+        AOTSpec("sp_attention_partials", (("sp", 8),),
+                _spec_sp_attention_partials),
         AOTSpec("flash_decode", (("sp", 8),), _spec_flash_decode),
         AOTSpec("ep_a2a", (("ep", 8),), _spec_ep_a2a),
         AOTSpec("ll_allgather", (("tp", 8),), _spec_ll_allgather),
