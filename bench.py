@@ -31,8 +31,10 @@ COMPARED ARE SAMPLED INTERLEAVED so drift cancels out of their ratio
 one-sided, so the low end is the least-contended estimate).
 """
 
+import contextlib
 import functools
 import json
+import os
 import time
 
 import jax
@@ -109,6 +111,17 @@ def _paired_slopes(loops, a, b, flops, rounds=8):
 
 
 def main():
+    # TDT_BENCH_PROFILE=1 wraps the measurement in the group_profile
+    # context (runtime/utils.py — the reference's cross-rank trace-merge
+    # analog); the XPlane trace lands under /tmp/tdtpu_trace/bench.
+    from triton_distributed_tpu.runtime.utils import group_profile
+
+    profiling = os.environ.get("TDT_BENCH_PROFILE", "0") == "1"
+    with group_profile("bench") if profiling else contextlib.nullcontext():
+        _run_benchmarks()
+
+
+def _run_benchmarks():
     from triton_distributed_tpu.kernels.allgather_gemm import (
         ag_gemm_loopback,
         ag_gemm_single_chip,

@@ -1,0 +1,309 @@
+// Native safetensors reader: mmap + header parse + zero-copy tensor views.
+//
+// The native IO layer of the runtime (the role csrc/ plays in the reference:
+// native components where there is real native work to do — here, loading
+// multi-GB checkpoints without copying every tensor through the Python
+// heap). The .safetensors format: 8-byte little-endian header length, a JSON
+// header {"name": {"dtype": "BF16", "shape": [..], "data_offsets": [b, e]},
+// ...}, then the raw tensor bytes. The file is mmap'd once; tensor data
+// pointers alias the mapping (zero-copy: Python wraps them in numpy views,
+// runtime/io_native.py), so the OS page cache — not Python — paces the IO.
+//
+// C API (ctypes-friendly; no pybind dependency):
+//   tdt_st_open/close, tdt_st_num_tensors, tdt_st_name/dtype/ndim/dim,
+//   tdt_st_data/nbytes, tdt_st_last_error.
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+thread_local std::string g_error;
+
+struct Tensor {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  int64_t begin = 0;
+  int64_t end = 0;
+};
+
+struct File {
+  void* map = MAP_FAILED;
+  size_t map_len = 0;
+  const uint8_t* data = nullptr;  // start of the tensor-data region
+  std::vector<Tensor> tensors;
+};
+
+// --- minimal JSON parser for the safetensors header subset ---------------
+// Grammar actually used by the format: an object of name -> object with
+// string / integer-array values; "__metadata__" holds string->string.
+
+struct Parser {
+  const char* p;
+  const char* end;
+
+  bool fail(const std::string& msg) {
+    g_error = "safetensors header parse error: " + msg;
+    return false;
+  }
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool expect(char c) {
+    ws();
+    if (p >= end || *p != c) return fail(std::string("expected '") + c + "'");
+    ++p;
+    return true;
+  }
+  bool string(std::string* out) {
+    ws();
+    if (p >= end || *p != '"') return fail("expected string");
+    ++p;
+    out->clear();
+    while (p < end && *p != '"') {
+      if (*p == '\\' && p + 1 < end) {
+        ++p;
+        switch (*p) {
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'u': {  // BMP codepoint -> UTF-8 (matches json.dumps output;
+                       // surrogate pairs don't appear in tensor names)
+            if (p + 4 >= end) return fail("truncated \\u escape");
+            unsigned cp = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              cp <<= 4;
+              if (c >= '0' && c <= '9') cp |= c - '0';
+              else if (c >= 'a' && c <= 'f') cp |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') cp |= c - 'A' + 10;
+              else return fail("bad \\u escape");
+            }
+            p += 4;
+            if (cp < 0x80) {
+              out->push_back(cp);
+            } else if (cp < 0x800) {
+              out->push_back(0xC0 | (cp >> 6));
+              out->push_back(0x80 | (cp & 0x3F));
+            } else {
+              out->push_back(0xE0 | (cp >> 12));
+              out->push_back(0x80 | ((cp >> 6) & 0x3F));
+              out->push_back(0x80 | (cp & 0x3F));
+            }
+            break;
+          }
+          default: out->push_back(*p);
+        }
+      } else {
+        out->push_back(*p);
+      }
+      ++p;
+    }
+    if (p >= end) return fail("unterminated string");
+    ++p;
+    return true;
+  }
+  bool integer(int64_t* out) {
+    ws();
+    bool neg = false;
+    if (p < end && *p == '-') { neg = true; ++p; }
+    if (p >= end || *p < '0' || *p > '9') return fail("expected integer");
+    int64_t v = 0;
+    while (p < end && *p >= '0' && *p <= '9') v = v * 10 + (*p++ - '0');
+    *out = neg ? -v : v;
+    return true;
+  }
+  bool int_array(std::vector<int64_t>* out) {
+    if (!expect('[')) return false;
+    out->clear();
+    ws();
+    if (p < end && *p == ']') { ++p; return true; }
+    while (true) {
+      int64_t v;
+      if (!integer(&v)) return false;
+      out->push_back(v);
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect(']');
+    }
+  }
+  // Skip any value (for __metadata__ payloads).
+  bool skip_value() {
+    ws();
+    if (p >= end) return fail("eof in value");
+    if (*p == '"') { std::string s; return string(&s); }
+    if (*p == '{') return skip_object();
+    if (*p == '[') {
+      ++p;
+      ws();
+      if (p < end && *p == ']') { ++p; return true; }
+      while (true) {
+        if (!skip_value()) return false;
+        ws();
+        if (p < end && *p == ',') { ++p; continue; }
+        return expect(']');
+      }
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']') ++p;  // literal
+    return true;
+  }
+  bool skip_object() {
+    if (!expect('{')) return false;
+    ws();
+    if (p < end && *p == '}') { ++p; return true; }
+    while (true) {
+      std::string key;
+      if (!string(&key) || !expect(':') || !skip_value()) return false;
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect('}');
+    }
+  }
+  bool tensor_entry(Tensor* t) {
+    if (!expect('{')) return false;
+    while (true) {
+      std::string key;
+      if (!string(&key) || !expect(':')) return false;
+      if (key == "dtype") {
+        if (!string(&t->dtype)) return false;
+      } else if (key == "shape") {
+        if (!int_array(&t->shape)) return false;
+      } else if (key == "data_offsets") {
+        std::vector<int64_t> off;
+        if (!int_array(&off)) return false;
+        if (off.size() != 2) return fail("data_offsets must have 2 entries");
+        t->begin = off[0];
+        t->end = off[1];
+      } else {
+        if (!skip_value()) return false;
+      }
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect('}');
+    }
+  }
+  bool header(std::vector<Tensor>* out) {
+    if (!expect('{')) return false;
+    ws();
+    if (p < end && *p == '}') { ++p; return true; }
+    while (true) {
+      std::string name;
+      if (!string(&name) || !expect(':')) return false;
+      if (name == "__metadata__") {
+        if (!skip_object()) return false;
+      } else {
+        Tensor t;
+        t.name = name;
+        if (!tensor_entry(&t)) return false;
+        out->push_back(std::move(t));
+      }
+      ws();
+      if (p < end && *p == ',') { ++p; continue; }
+      return expect('}');
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+const char* tdt_st_last_error() { return g_error.c_str(); }
+
+void* tdt_st_open(const char* path) {
+  g_error.clear();
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    g_error = std::string("open failed: ") + path;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < 8) {
+    g_error = "stat failed or file too small";
+    ::close(fd);
+    return nullptr;
+  }
+  void* map = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);  // the mapping keeps the file alive
+  if (map == MAP_FAILED) {
+    g_error = "mmap failed";
+    return nullptr;
+  }
+  auto* f = new File;
+  f->map = map;
+  f->map_len = st.st_size;
+  uint64_t hlen;
+  std::memcpy(&hlen, map, 8);  // little-endian per format (and host)
+  // Overflow-safe form: `8 + hlen > size` wraps for hlen near 2^64.
+  if (hlen > static_cast<uint64_t>(st.st_size) - 8) {
+    g_error = "header length exceeds file size";
+    munmap(map, st.st_size);
+    delete f;
+    return nullptr;
+  }
+  const char* hdr = static_cast<const char*>(map) + 8;
+  Parser parser{hdr, hdr + hlen};
+  if (!parser.header(&f->tensors)) {
+    munmap(map, st.st_size);
+    delete f;
+    return nullptr;
+  }
+  f->data = static_cast<const uint8_t*>(map) + 8 + hlen;
+  const int64_t data_len = st.st_size - 8 - hlen;
+  for (const Tensor& t : f->tensors) {
+    if (t.begin < 0 || t.end < t.begin || t.end > data_len) {
+      g_error = "tensor '" + t.name + "' offsets out of range";
+      munmap(map, st.st_size);
+      delete f;
+      return nullptr;
+    }
+  }
+  return f;
+}
+
+void tdt_st_close(void* h) {
+  auto* f = static_cast<File*>(h);
+  if (!f) return;
+  if (f->map != MAP_FAILED) munmap(f->map, f->map_len);
+  delete f;
+}
+
+int64_t tdt_st_num_tensors(void* h) {
+  return static_cast<File*>(h)->tensors.size();
+}
+
+const char* tdt_st_name(void* h, int64_t i) {
+  return static_cast<File*>(h)->tensors[i].name.c_str();
+}
+
+const char* tdt_st_dtype(void* h, int64_t i) {
+  return static_cast<File*>(h)->tensors[i].dtype.c_str();
+}
+
+int32_t tdt_st_ndim(void* h, int64_t i) {
+  return static_cast<File*>(h)->tensors[i].shape.size();
+}
+
+int64_t tdt_st_dim(void* h, int64_t i, int32_t d) {
+  return static_cast<File*>(h)->tensors[i].shape[d];
+}
+
+const void* tdt_st_data(void* h, int64_t i) {
+  auto* f = static_cast<File*>(h);
+  return f->data + f->tensors[i].begin;
+}
+
+int64_t tdt_st_nbytes(void* h, int64_t i) {
+  const Tensor& t = static_cast<File*>(h)->tensors[i];
+  return t.end - t.begin;
+}
+
+}  // extern "C"

@@ -13,7 +13,7 @@ from triton_distributed_tpu.runtime import (
     perf_func,
 )
 from triton_distributed_tpu.runtime.mesh import ring_neighbors
-from triton_distributed_tpu.runtime.symm import clear_workspaces, signal_buffer
+from triton_distributed_tpu.runtime.symm import clear_workspaces
 
 
 def test_make_mesh_default(mesh8):
@@ -49,12 +49,6 @@ def test_workspace_persistence(mesh8):
     assert w1.array.shape == (8, 16, 128)
     w3 = get_workspace("ag", (32, 128), jnp.float32, mesh=mesh8)
     assert w3 is not w1
-
-
-def test_signal_buffer(mesh8):
-    s = signal_buffer("barrier", 4, mesh=mesh8)
-    assert s.array.shape == (8, 4)
-    assert s.array.dtype == jnp.int32
 
 
 def test_perf_func():

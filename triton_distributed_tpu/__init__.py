@@ -24,7 +24,10 @@ Layer map (mirrors reference SURVEY.md §1, re-based on the TPU stack):
                   topology compile + serialized-executable cache, profiler;
                   analog of python/triton_dist/tools/)
 
-The compute path is pure JAX/Pallas. The AOT path is ``tools.aot``:
+The compute path is pure JAX/Pallas; native (C++) runtime IO lives in
+``csrc/`` (mmap safetensors reader, built by ``make -C csrc`` and loaded via
+ctypes with a pure-Python fallback — runtime/io_native.py). The AOT path is
+``tools.aot``:
 Mosaic-compilation of every flagship kernel against a detached TPU topology
 descriptor at production shapes (tests/test_mosaic_aot.py) plus a
 serialized-executable cache that cuts engine cold-start

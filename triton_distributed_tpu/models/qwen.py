@@ -141,11 +141,14 @@ class Qwen3:
     def load_hf(self, path: str, mesh: Mesh | None = None):
         """Load weights from a local HuggingFace Qwen3 checkpoint directory
         (reference ``init_parameters``, qwen.py:147 + per-layer shard_local,
-        tp_attn.py:97). Reads *.safetensors; no network access."""
+        tp_attn.py:97). Reads *.safetensors; no network access. Uses the
+        native mmap reader (csrc/ via runtime/io_native.py — zero-copy
+        page-cache views) when available, the ``safetensors`` package
+        otherwise; identical results (tests/test_native_io.py)."""
         import glob
         import os
 
-        from safetensors import safe_open
+        from triton_distributed_tpu.runtime import io_native
 
         mesh = mesh or get_default_mesh()
         world = mesh.shape[self.axis]
@@ -153,11 +156,16 @@ class Qwen3:
         files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
         if not files:
             raise FileNotFoundError(f"no *.safetensors under {path!r}")
-        raw = {}
-        for f in files:
-            with safe_open(f, framework="np") as sf:
-                for name in sf.keys():
-                    raw[name] = sf.get_tensor(name)
+        if io_native.available():
+            raw = io_native.read_checkpoint(files)
+        else:
+            from safetensors import safe_open
+
+            raw = {}
+            for f in files:
+                with safe_open(f, framework="np") as sf:
+                    for name in sf.keys():
+                        raw[name] = sf.get_tensor(name)
 
         def t(name):  # HF stores (out, in); we use (in, out)
             return jnp.asarray(raw[name]).T.astype(c.dtype)

@@ -1,0 +1,158 @@
+"""Native checkpoint IO: ctypes binding of the csrc/ safetensors reader.
+
+The runtime's native (C++) IO component — the role the reference's ``csrc/``
+plays (native code where there is real native work: here, mmap-based
+zero-copy loading of multi-GB checkpoints, so tensor bytes go page-cache ->
+device without a Python-heap copy per tensor). ``Qwen3.load_hf`` uses this
+reader when the shared library is available (built on demand with ``make -C
+csrc``; g++ is part of the toolchain) and falls back to the ``safetensors``
+package otherwise — behavior is identical, verified by
+tests/test_native_io.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Iterator
+
+import numpy as np
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_SO = os.path.join(_CSRC, "build", "libtdt_st.so")
+
+# safetensors dtype tag -> numpy dtype (BF16 via ml_dtypes, jax's dep).
+def _dtype_table():
+    import ml_dtypes
+
+    return {
+        "F64": np.float64, "F32": np.float32, "F16": np.float16,
+        "BF16": ml_dtypes.bfloat16,
+        "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+        "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+        "BOOL": np.bool_,
+        "F8_E4M3": ml_dtypes.float8_e4m3fn, "F8_E5M2": ml_dtypes.float8_e5m2,
+    }
+
+
+_lib = None  # None = untried, False = build/load failed (cached), else CDLL
+
+
+def _load_lib(build: bool = True):
+    """dlopen the reader, building it with make on first use. Returns None
+    (with no exception) when the library cannot be built/loaded — callers
+    fall back to the pure-Python path. Failure is cached so a toolchain-less
+    host pays the make attempt once, not per load_hf call."""
+    global _lib
+    if _lib is False:
+        return None
+    if _lib is not None:
+        return _lib
+    if not os.path.exists(_SO) and build:
+        try:
+            subprocess.run(["make", "-C", _CSRC], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            _lib = False
+            return None
+    if not os.path.exists(_SO):
+        _lib = False
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        _lib = False
+        return None
+    lib.tdt_st_open.restype = ctypes.c_void_p
+    lib.tdt_st_open.argtypes = [ctypes.c_char_p]
+    lib.tdt_st_close.argtypes = [ctypes.c_void_p]
+    lib.tdt_st_num_tensors.restype = ctypes.c_int64
+    lib.tdt_st_num_tensors.argtypes = [ctypes.c_void_p]
+    lib.tdt_st_name.restype = ctypes.c_char_p
+    lib.tdt_st_name.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tdt_st_dtype.restype = ctypes.c_char_p
+    lib.tdt_st_dtype.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tdt_st_ndim.restype = ctypes.c_int32
+    lib.tdt_st_ndim.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tdt_st_dim.restype = ctypes.c_int64
+    lib.tdt_st_dim.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                               ctypes.c_int32]
+    lib.tdt_st_data.restype = ctypes.c_void_p
+    lib.tdt_st_data.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tdt_st_nbytes.restype = ctypes.c_int64
+    lib.tdt_st_nbytes.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    lib.tdt_st_last_error.restype = ctypes.c_char_p
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    """True when the native reader can be used (built or buildable), and
+    TDT_NATIVE_IO is not 0."""
+    if os.environ.get("TDT_NATIVE_IO", "1") == "0":
+        return False
+    return _load_lib() is not None
+
+
+class NativeSafetensors:
+    """Zero-copy view of one .safetensors file through the mmap reader.
+
+    Tensors are numpy arrays ALIASING the mapping — valid only until
+    ``close`` (or garbage collection). Callers that let any consumer outlive
+    the reader must copy first; note jax's CPU backend may alias aligned
+    numpy buffers in ``device_put`` rather than copying."""
+
+    def __init__(self, path: str):
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native safetensors reader unavailable")
+        self._lib = lib
+        self._h = lib.tdt_st_open(path.encode())
+        if not self._h:
+            raise OSError(lib.tdt_st_last_error().decode())
+        self._dtypes = _dtype_table()
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.tdt_st_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def items(self) -> Iterator[tuple[str, np.ndarray]]:
+        lib, h = self._lib, self._h
+        for i in range(lib.tdt_st_num_tensors(h)):
+            name = lib.tdt_st_name(h, i).decode()
+            tag = lib.tdt_st_dtype(h, i).decode()
+            dtype = self._dtypes.get(tag)
+            if dtype is None:
+                raise ValueError(f"unsupported safetensors dtype {tag!r}")
+            shape = tuple(lib.tdt_st_dim(h, i, d)
+                          for d in range(lib.tdt_st_ndim(h, i)))
+            nbytes = lib.tdt_st_nbytes(h, i)
+            buf = (ctypes.c_char * nbytes).from_address(lib.tdt_st_data(h, i))
+            arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+            yield name, arr
+
+
+def read_checkpoint(files: list[str]) -> dict[str, np.ndarray]:
+    """All tensors of a sharded checkpoint, name -> OWNED array (one memcpy
+    from the page cache, no per-tensor Python file IO). Copying here is
+    deliberate: a zero-copy view handed to jax.device_put can be aliased
+    by the CPU backend and then outlive the munmap'd mapping (use
+    ``NativeSafetensors.items`` directly for managed-lifetime views)."""
+    out: dict[str, np.ndarray] = {}
+    for f in files:
+        with NativeSafetensors(f) as reader:
+            for name, arr in reader.items():
+                out[name] = np.array(arr)
+    return out

@@ -14,6 +14,10 @@ What remains worth managing is *persistence*: overlap kernels want their
 gather/scatter scratch and signal cells allocated once per (op, shape) and
 reused across steps (reference ``create_*_context`` factories). This registry
 provides that.
+
+(The reference also allocates symmetric barrier/signal CELLS,
+allgather_gemm.py:404 — on TPU that role is filled by hardware semaphores
+inside the kernels, so no signal-cell workspace exists here by design.)
 """
 
 from __future__ import annotations
@@ -76,14 +80,6 @@ def get_workspace(
     elif zero:
         ws.zero()
     return ws
-
-
-def signal_buffer(name: str, n_signals: int, *, mesh: Mesh, axis: str = "tp") -> SymmetricWorkspace:
-    """Persistent int32 signal cells, one row per rank (the analog of the
-    reference's barrier/signal symmetric tensors, e.g. allgather_gemm.py:404
-    ``barrier_bufs``). Pallas kernels flip these with remote stores; host code
-    reads them as ordinary array values."""
-    return get_workspace(f"signal:{name}", (n_signals,), jnp.int32, mesh=mesh, axis=axis)
 
 
 def clear_workspaces() -> None:
