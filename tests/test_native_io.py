@@ -74,6 +74,38 @@ def test_open_errors_are_reported(built, tmp_path):
         io_native.NativeSafetensors(str(bad))
 
 
+def test_views_are_readonly_and_shapes_validated(built, tmp_path):
+    """Zero-copy views alias PROT_READ pages: the numpy flag must be off so
+    an in-place write raises instead of SIGSEGVing; corrupt header shapes
+    (e.g. [-1, 4], which numpy reshape would silently 'infer') must raise."""
+    import json
+    import struct
+
+    rng = np.random.default_rng(2)
+    f = tmp_path / "t.safetensors"
+    _write(f, {"w": rng.standard_normal((4, 4)).astype(np.float32)})
+    with io_native.NativeSafetensors(str(f)) as reader:
+        (_, arr), = reader.items()
+        assert not arr.flags.writeable
+        with pytest.raises(ValueError):
+            arr[0, 0] = 1.0
+
+    # Hand-craft a header whose shape lies about the payload.
+    def craft(shape):
+        payload = b"\x00" * 64
+        header = json.dumps({"w": {
+            "dtype": "F32", "shape": shape,
+            "data_offsets": [0, len(payload)]}}).encode()
+        p = tmp_path / "crafted.safetensors"
+        p.write_bytes(struct.pack("<Q", len(header)) + header + payload)
+        return str(p)
+
+    for shape in ([-1, 4], [3, 5], [0, 4]):  # inferred / mismatch / mismatch
+        with io_native.NativeSafetensors(craft(shape)) as reader:
+            with pytest.raises(ValueError, match="dim|payload"):
+                dict(reader.items())
+
+
 def test_load_hf_native_matches_fallback(built, tmp_path, monkeypatch, mesh8):
     """load_hf through the native reader produces the identical pytree to
     the safetensors-package fallback."""

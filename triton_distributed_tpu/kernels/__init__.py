@@ -34,12 +34,14 @@ from triton_distributed_tpu.kernels.collective_2d import (  # noqa: F401
 from triton_distributed_tpu.kernels.allgather_gemm import (  # noqa: F401
     AGGEMMConfig,
     ag_gemm,
+    ag_gemm_2d_device,
     ag_gemm_device,
     ag_gemm_single_chip,
 )
 from triton_distributed_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
     GEMMRSConfig,
     gemm_rs,
+    gemm_rs_2d_device,
     gemm_rs_device,
 )
 from triton_distributed_tpu.kernels.ep_all_to_all import (  # noqa: F401
@@ -49,8 +51,11 @@ from triton_distributed_tpu.kernels.ep_all_to_all import (  # noqa: F401
 )
 from triton_distributed_tpu.kernels.moe_overlap import (  # noqa: F401
     MoEOverlapConfig,
+    ag_group_gemm_2d_device,
     ag_group_gemm_device,
+    ag_moe_mlp_2d_device,
     ag_moe_mlp_device,
+    group_gemm_rs_2d_device,
     group_gemm_rs_device,
 )
 from triton_distributed_tpu.kernels import moe_utils  # noqa: F401

@@ -277,6 +277,50 @@ def ag_gemm_loopback(a, b, *, segments: int = 8,
     return out
 
 
+def ag_gemm_2d_device(a_local, b_local, *, ici_axis: str = "ici",
+                      dcn_axis: str = "dcn",
+                      config: AGGEMMConfig | None = None, interpret=None):
+    """Inter-slice AG-GEMM over a (dcn, ici) mesh — the DCN leg of the
+    flagship overlap op (the reference gathers A across nodes with NVSHMEM
+    put kernels, ``allgather.py:554`` / ``allgather_gemm.py`` inter-node
+    dispatch; SURVEY §2.5 "inter_node" scope).
+
+    A is sharded on M over ALL devices (dcn-major): per-device ``(m, K)``;
+    B is sharded on N over the full world: per-device ``(K, n_local)``.
+    Returns ``(n_slices * w_ici * m, n_local)`` — the full-M product.
+
+    TPU design (SURVEY §7 hard-part 6: DCN has no device-initiated one-sided
+    op): intra-slice gathering stays inside the Pallas overlap kernel
+    (``ag_gemm_device``); INTER-slice A blocks ride a slice-level
+    ``lax.ppermute`` ring over ``dcn_axis``. The permute of the next A block
+    has no data dependence on the current kernel call, so XLA schedules the
+    DCN hop concurrently with the intra-slice overlapped matmul — comm
+    hidden at both levels (ICI inside the kernel, DCN behind whole kernel
+    calls)."""
+    from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
+
+    n_slices = jax.lax.axis_size(dcn_axis)
+    if n_slices == 1:
+        return ag_gemm_device(a_local, b_local, axis=ici_axis, config=config,
+                              interpret=interpret)
+    w_ici = jax.lax.axis_size(ici_axis)
+    m, k = a_local.shape
+    n_local = b_local.shape[1]
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+
+    def block(step, cur, ab):                         # (w_ici*m, n_local)
+        return ag_gemm_device(ab, b_local, axis=ici_axis, config=config,
+                              interpret=interpret)
+
+    def place(acc, cur, blk):
+        return jax.lax.dynamic_update_slice(
+            acc, blk.astype(out_dtype), (cur * (w_ici * m), 0))
+
+    return dcn_ring_walk(
+        block, place, jnp.zeros((n_slices * w_ici * m, n_local), out_dtype),
+        (a_local,), dcn_axis=dcn_axis)
+
+
 # ---------------------------------------------------------------------------
 # Single-chip tiled matmul (world == 1 degenerate path; also the bench.py
 # kernel: MXU-tiled, f32 accumulation).

@@ -38,6 +38,61 @@ from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_scatter
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
+# ---------------------------------------------------------------------------
+# DCN ring scaffolding, shared by every inter-slice overlap op (ag_gemm_2d,
+# gemm_rs_2d, the 2D MoE pair, sp_ag_attention_2d). Two shapes exist:
+# allgather-style (operands travel the ring, results fold locally) and
+# reduce-scatter-style (the accumulator travels the ring, add-and-forward).
+# Centralized because the block-ownership arithmetic is subtle and must stay
+# identical everywhere.
+# ---------------------------------------------------------------------------
+
+
+def dcn_ring_walk(block_fn, combine, init, ringed, *, dcn_axis: str = "dcn"):
+    """Allgather-style DCN ring. The RINGED operands travel slice-to-slice
+    (forward ``lax.ppermute`` ring); at step t this device holds the
+    operands of slice ``cur = (sid - t) % n`` and folds
+    ``block_fn(step, cur, *ringed)`` into a local accumulator with
+    ``combine(acc, cur, block)``. The permute of the next operands has no
+    data dependence on the current block's compute, so XLA runs the DCN hop
+    under it."""
+    n = jax.lax.axis_size(dcn_axis)
+    sid = jax.lax.axis_index(dcn_axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = init
+    ringed = tuple(ringed)
+    cur = sid
+    for step in range(n):
+        acc = combine(acc, cur, block_fn(step, cur, *ringed))
+        if step < n - 1:
+            ringed = tuple(jax.lax.ppermute(r, dcn_axis, perm)
+                           for r in ringed)
+            cur = jax.lax.rem(cur - 1 + n, n)
+    return acc
+
+
+def dcn_ring_reduce_scatter(part_fn, init, *, dcn_axis: str = "dcn"):
+    """Reduce-scatter-style DCN ring (add-and-forward): at step t this
+    device computes ``part_fn(blk)`` for the block owned by slice
+    ``blk = (sid - 1 - t) % n``, adds the partial accumulator arriving from
+    its ring predecessor (which processed the same block last step), and
+    forwards. A block is first touched by its ring-successor and reaches
+    its owner at the last step with every slice's contribution folded in.
+    ``init`` fixes the accumulator shape/dtype (use fp32). The next step's
+    ``part_fn`` has no data dependence on the in-flight permute (only the
+    cheap add joins them), so the DCN hop rides under the compute."""
+    n = jax.lax.axis_size(dcn_axis)
+    sid = jax.lax.axis_index(dcn_axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    acc = init
+    for t in range(n):
+        blk = jax.lax.rem(sid - 1 - t + 2 * n, n)
+        acc = acc + part_fn(blk)
+        if t < n - 1:
+            acc = jax.lax.ppermute(acc, dcn_axis, perm)
+    return acc
+
+
 def all_gather_2d_device(x_local, *, ici_axis: str = "ici",
                          dcn_axis: str = "dcn", interpret=None):
     """Per-device 2D allgather: ``(m, ...)`` -> ``(W*m, ...)`` with segments

@@ -221,6 +221,56 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
     return out
 
 
+def gemm_rs_2d_device(a_local, b_local, *, ici_axis: str = "ici",
+                      dcn_axis: str = "dcn",
+                      config: GEMMRSConfig | None = None, interpret=None):
+    """Inter-slice GEMM-RS over a (dcn, ici) mesh — the DCN leg of the
+    row-parallel overlap op (the reference's 2D reduce-scatter: intra-node
+    scatter -> local reduce -> inter-node p2p of same-local-rank segments,
+    ``reduce_scatter.py:45,:605``).
+
+    K is sharded over ALL devices (dcn-major): per-device A ``(M, k_local)``,
+    B ``(k_local, N)``. Returns ``(M / (n_slices * w_ici), N)`` — this
+    device's segment of the fully-reduced product.
+
+    TPU design: a ring reduce-scatter over the DCN axis at slice-block
+    granularity. At step t a slice computes the intra-slice GEMM-RS (the
+    Pallas overlap kernel — push-as-computed partials over ICI) for the M
+    block owned by slice ``(sid - 1 - t) % n_slices``, adds the partial
+    accumulator arriving from the previous slice in the ring, and forwards.
+    After ``n_slices`` steps each device holds its own block with all
+    ``n_slices * w_ici`` contributions folded in. The next step's kernel has
+    no data dependence on the in-flight ppermute (only the cheap add joins
+    them), so XLA runs the DCN hop under the intra-slice overlapped matmul."""
+    from triton_distributed_tpu.kernels.collective_2d import (
+        dcn_ring_reduce_scatter,
+    )
+
+    n_slices = jax.lax.axis_size(dcn_axis)
+    if n_slices == 1:
+        return gemm_rs_device(a_local, b_local, axis=ici_axis, config=config,
+                              interpret=interpret)
+    w_ici = jax.lax.axis_size(ici_axis)
+    M, k_local = a_local.shape
+    n = b_local.shape[1]
+    if M % (n_slices * w_ici):
+        raise ValueError(
+            f"M {M} not divisible by world {n_slices * w_ici}")
+    m_slice = M // n_slices
+    m_out = m_slice // w_ici
+    out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
+
+    def part(blk):                                    # (m_out, n) fp32
+        a_blk = jax.lax.dynamic_slice(
+            a_local, (blk * m_slice, 0), (m_slice, k_local))
+        return gemm_rs_device(a_blk, b_local, axis=ici_axis, config=config,
+                              interpret=interpret).astype(jnp.float32)
+
+    acc = dcn_ring_reduce_scatter(
+        part, jnp.zeros((m_out, n), jnp.float32), dcn_axis=dcn_axis)
+    return acc.astype(out_dtype)
+
+
 def gemm_rs(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
             config: GEMMRSConfig | None = None, interpret=None):
     """Standalone GEMM-RS over a mesh axis.

@@ -386,3 +386,119 @@ def ag_moe_mlp_device(x_local, topk_ids_local, topk_weights_local, w_up_local,
         down, topk_ids_local, topk_weights_local, state["slot"],
         state["kept"])
     return out, state["n_dropped"]
+
+
+# ---------------------------------------------------------------------------
+# Inter-slice (DCN) legs — slice-level ppermute rings around the intra-slice
+# overlap kernels, the MoE analog of ag_gemm_2d_device / gemm_rs_2d_device
+# (the reference's inter-node MoE paths: moe_reduce_rs.py:605 inter-node p2p).
+# ---------------------------------------------------------------------------
+
+
+def ag_group_gemm_2d_device(x_local, topk_ids_local, w_up_local, *,
+                            n_experts: int, capacity: int,
+                            ici_axis: str = "ici", dcn_axis: str = "dcn",
+                            config: MoEOverlapConfig | None = None,
+                            interpret=None):
+    """AG-GroupGEMM over a (dcn, ici) mesh: tokens sharded over ALL devices
+    (dcn-major), expert weights f-sharded over the full world. Intra-slice
+    grids gather inside the Pallas overlap kernel; inter-slice token blocks
+    ride a slice-level ppermute ring, re-routed locally per slice (routing
+    is cheap jnp; the grid ships as raw tokens so the DCN payload is the
+    same bytes the reference moves). Returns
+    (up (E, n_slices*w_ici*cap, f_local), state-of-own-slice)."""
+    from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
+
+    n_slices = jax.lax.axis_size(dcn_axis)
+    if n_slices == 1:
+        return ag_group_gemm_device(
+            x_local, topk_ids_local, w_up_local, n_experts=n_experts,
+            capacity=capacity, axis=ici_axis, config=config,
+            interpret=interpret)
+    w_ici = jax.lax.axis_size(ici_axis)
+    E, _, f_local = w_up_local.shape
+    out_dtype = jnp.promote_types(x_local.dtype, w_up_local.dtype)
+    own_state = {}
+
+    def block(step, cur, xb, idsb):
+        blk, st = ag_group_gemm_device(
+            xb, idsb, w_up_local, n_experts=n_experts, capacity=capacity,
+            axis=ici_axis, config=config, interpret=interpret)
+        if step == 0:
+            # Own tokens' routing bookkeeping (the combine needs it).
+            own_state["state"] = st
+        return blk
+
+    def place(acc, cur, blk):
+        return jax.lax.dynamic_update_slice(
+            acc, blk.astype(out_dtype), (0, cur * (w_ici * capacity), 0))
+
+    up = dcn_ring_walk(
+        block, place,
+        jnp.zeros((E, n_slices * w_ici * capacity, f_local), out_dtype),
+        (x_local, topk_ids_local), dcn_axis=dcn_axis)
+    return up, own_state["state"]
+
+
+def group_gemm_rs_2d_device(act, w_down_local, *, capacity: int,
+                            ici_axis: str = "ici", dcn_axis: str = "dcn",
+                            config: MoEOverlapConfig | None = None,
+                            interpret=None):
+    """GroupGEMM-reduce-RS over a (dcn, ici) mesh: ring reduce-scatter over
+    the DCN axis at slice-block granularity (add-and-forward), intra-slice
+    partials pushed-as-computed inside the Pallas kernel. ``act`` is
+    (E, n_slices*w_ici*cap, f_local) in the 2D AG-GroupGEMM layout. Returns
+    (E, cap, d): this device's own cap rows per expert, reduced over the
+    FULL world's f shards."""
+    from triton_distributed_tpu.kernels.collective_2d import (
+        dcn_ring_reduce_scatter,
+    )
+
+    n_slices = jax.lax.axis_size(dcn_axis)
+    if n_slices == 1:
+        return group_gemm_rs_device(act, w_down_local, capacity=capacity,
+                                    axis=ici_axis, config=config,
+                                    interpret=interpret)
+    w_ici = jax.lax.axis_size(ici_axis)
+    E, rows, f_local = act.shape
+    d = w_down_local.shape[2]
+    if rows != n_slices * w_ici * capacity:
+        raise ValueError(
+            f"act rows {rows} != world*capacity {n_slices * w_ici * capacity}")
+    out_dtype = jnp.promote_types(act.dtype, w_down_local.dtype)
+
+    def part(blk):                                       # (E, cap, d) fp32
+        act_blk = jax.lax.dynamic_slice(
+            act, (0, blk * (w_ici * capacity), 0),
+            (E, w_ici * capacity, f_local))
+        return group_gemm_rs_device(
+            act_blk, w_down_local, capacity=capacity, axis=ici_axis,
+            config=config, interpret=interpret).astype(jnp.float32)
+
+    acc = dcn_ring_reduce_scatter(
+        part, jnp.zeros((E, capacity, d), jnp.float32), dcn_axis=dcn_axis)
+    return acc.astype(out_dtype)
+
+
+def ag_moe_mlp_2d_device(x_local, topk_ids_local, topk_weights_local,
+                         w_up_local, w_down_local, *, n_experts: int,
+                         capacity: int, activation=jax.nn.silu,
+                         ici_axis: str = "ici", dcn_axis: str = "dcn",
+                         config: MoEOverlapConfig | None = None,
+                         interpret=None):
+    """Full MoE-TP MLP over a (dcn, ici) mesh: 2D AG-GroupGEMM(up) -> act ->
+    2D GroupGEMM-RS(down) -> local topk-combine. The inter-slice legs ride
+    XLA DCN collectives under the intra-slice Pallas kernels (SURVEY §7
+    hard-part 6)."""
+    up, state = ag_group_gemm_2d_device(
+        x_local, topk_ids_local, w_up_local, n_experts=n_experts,
+        capacity=capacity, ici_axis=ici_axis, dcn_axis=dcn_axis,
+        config=config, interpret=interpret)
+    act = activation(up.astype(jnp.float32)).astype(up.dtype)
+    down = group_gemm_rs_2d_device(
+        act, w_down_local, capacity=capacity, ici_axis=ici_axis,
+        dcn_axis=dcn_axis, config=config, interpret=interpret)
+    out = moe_utils.combine_from_experts(
+        down, topk_ids_local, topk_weights_local, state["slot"],
+        state["kept"])
+    return out, state["n_dropped"]

@@ -234,7 +234,8 @@ def sp_ag_attention_2d_device(q_local, k_local, v_local, *,
     XLA schedules the next ppermute concurrently with the current slice's
     attention kernel (async collective + custom call), so the DCN hop rides
     under intra-slice compute."""
-    n_slices = jax.lax.axis_size(dcn_axis)
+    from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
+
     w_ici = jax.lax.axis_size(ici_axis)
     H, m, dh = q_local.shape
     m_kv = k_local.shape[1]
@@ -243,29 +244,29 @@ def sp_ag_attention_2d_device(q_local, k_local, v_local, *,
     me = jax.lax.axis_index(ici_axis)
     row0 = (sid * w_ici + me) * m
 
-    acc = jnp.zeros((H, m, dh), jnp.float32)
-    mx = jnp.full((H, m, 1), _NEG_INF, jnp.float32)
-    den = jnp.zeros((H, m, 1), jnp.float32)
-    kb, vb = k_local, v_local
-    cur = sid  # slice whose KV block this device currently holds
-    perm = [(i, (i + 1) % n_slices) for i in range(n_slices)]
-    for step in range(n_slices):
+    def block(step, cur, kb, vb):
         col0 = cur * w_ici * m_kv
-        out_p, lse_p = sp_ag_attention_device(
+        return sp_ag_attention_device(
             q_local, kb, vb, axis=ici_axis, causal=causal, scale=scale,
             row_offset=row0, col_offset=col0, return_partials=True,
             interpret=interpret)
+
+    def merge(carry, cur, blk):
+        acc, mx, den = carry
+        out_p, lse_p = blk
         lse = lse_p[..., None]
         new_mx = jnp.maximum(mx, lse)
         c_old = jnp.exp(mx - new_mx)
         c_new = jnp.exp(lse - new_mx)
-        acc = acc * c_old + out_p.astype(jnp.float32) * c_new
-        den = den * c_old + c_new
-        mx = new_mx
-        if step < n_slices - 1:
-            kb = jax.lax.ppermute(kb, dcn_axis, perm)
-            vb = jax.lax.ppermute(vb, dcn_axis, perm)
-            cur = jax.lax.rem(cur - 1 + n_slices, n_slices)
+        return (acc * c_old + out_p.astype(jnp.float32) * c_new,
+                new_mx, den * c_old + c_new)
+
+    acc, _, den = dcn_ring_walk(
+        block, merge,
+        (jnp.zeros((H, m, dh), jnp.float32),
+         jnp.full((H, m, 1), _NEG_INF, jnp.float32),
+         jnp.zeros((H, m, 1), jnp.float32)),
+        (k_local, v_local), dcn_axis=dcn_axis)
     return (acc / jnp.maximum(den, 1e-30)).astype(q_local.dtype)
 
 

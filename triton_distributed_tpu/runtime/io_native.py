@@ -51,9 +51,18 @@ def _load_lib(build: bool = True):
     if _lib is not None:
         return _lib
     if not os.path.exists(_SO) and build:
+        # Serialize the build across processes: a multi-process job calls
+        # load_hf on every host process at startup, and concurrent `make`s
+        # write the .so in place — a loser could dlopen a half-written file.
         try:
-            subprocess.run(["make", "-C", _CSRC], check=True,
-                           capture_output=True, timeout=120)
+            os.makedirs(os.path.dirname(_SO), exist_ok=True)
+            import fcntl
+
+            with open(_SO + ".lock", "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                if not os.path.exists(_SO):  # winner built it while we waited
+                    subprocess.run(["make", "-C", _CSRC], check=True,
+                                   capture_output=True, timeout=120)
         except Exception:
             _lib = False
             return None
@@ -139,8 +148,27 @@ class NativeSafetensors:
             shape = tuple(lib.tdt_st_dim(h, i, d)
                           for d in range(lib.tdt_st_ndim(h, i)))
             nbytes = lib.tdt_st_nbytes(h, i)
+            # Validate the header's shape against the payload here, where
+            # the dtype table lives: a corrupt/malicious shape like [-1, 4]
+            # would otherwise reach numpy's reshape, which treats -1 as an
+            # inferred dim and silently yields a wrong-shaped tensor.
+            itemsize = np.dtype(dtype).itemsize
+            n_elems = 1
+            for d in shape:
+                if d < 0:
+                    raise ValueError(
+                        f"tensor {name!r}: negative dim in shape {shape}")
+                n_elems *= d
+            if n_elems * itemsize != nbytes:
+                raise ValueError(
+                    f"tensor {name!r}: shape {shape} x itemsize {itemsize} "
+                    f"!= payload bytes {nbytes}")
             buf = (ctypes.c_char * nbytes).from_address(lib.tdt_st_data(h, i))
             arr = np.frombuffer(buf, dtype=dtype).reshape(shape)
+            # The pages behind this view are PROT_READ; a writable numpy
+            # flag would turn an accidental in-place write into a SIGSEGV
+            # instead of a Python ValueError.
+            arr.flags.writeable = False
             yield name, arr
 
 

@@ -104,6 +104,38 @@ def _spec_gemm_rs(mesh):
                 _sds((25600, 5120), jnp.bfloat16))
 
 
+def _spec_ag_gemm_2d(mesh):
+    from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_2d_device
+
+    def f(al, bl):
+        return ag_gemm_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
+                                 interpret=False)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
+        out_specs=P(None, ("dcn", "ici")), check_vma=False)
+    return sm, (_sds((4096, 5120), jnp.bfloat16),
+                _sds((5120, 25600), jnp.bfloat16))
+
+
+def _spec_gemm_rs_2d(mesh):
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        gemm_rs_2d_device,
+    )
+
+    def f(al, bl):
+        return gemm_rs_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
+                                 interpret=False)
+
+    sm = jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=P(("dcn", "ici"), None), check_vma=False)
+    return sm, (_sds((4096, 25600), jnp.bfloat16),
+                _sds((25600, 5120), jnp.bfloat16))
+
+
 def _spec_ag_group_gemm(mesh):
     from triton_distributed_tpu.kernels.moe_overlap import ag_group_gemm_device
 
@@ -285,6 +317,8 @@ FLAGSHIP_SPECS: dict[str, AOTSpec] = {
     for s in [
         AOTSpec("ag_gemm", (("tp", 8),), _spec_ag_gemm),
         AOTSpec("gemm_rs", (("tp", 8),), _spec_gemm_rs),
+        AOTSpec("ag_gemm_2d", (("dcn", 2), ("ici", 4)), _spec_ag_gemm_2d),
+        AOTSpec("gemm_rs_2d", (("dcn", 2), ("ici", 4)), _spec_gemm_rs_2d),
         AOTSpec("ag_group_gemm", (("tp", 8),), _spec_ag_group_gemm),
         AOTSpec("group_gemm_rs", (("tp", 8),), _spec_group_gemm_rs),
         AOTSpec("sp_attention", (("sp", 8),), _spec_sp_attention),

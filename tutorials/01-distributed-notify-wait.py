@@ -1,0 +1,154 @@
+"""Tutorial 01 — Distributed notify and wait.
+
+What you learn (TPU edition of the reference's tutorial 01):
+
+* The signal-exchange concept: on GPUs the reference spin-waits on barrier
+  cells in NVSHMEM symmetric memory (``dl.wait`` / ``dl.notify``). On TPU
+  the hardware primitive is the *semaphore*: ``dl.notify(sem, peer)`` is a
+  remote semaphore signal over ICI, ``dl.wait(sem, n)`` blocks until the
+  semaphore accumulated ``n`` — and, crucially, a successful wait also
+  orders the DMA effects tracked by that semaphore, so the reference's
+  acquire/relaxed scope lattice collapses (see
+  ``triton_distributed_tpu/language/primitives.py``).
+* ``dl.consume_token``: on GPUs it builds an artificial data dependence so
+  the compiler cannot hoist loads above a wait. Mosaic orders memory ops
+  with semaphore waits by program order, so on TPU it is the identity —
+  kept so kernels read the same.
+* A producer→consumer transfer through a small queue: the producer pushes
+  a chunk into the consumer's buffer with a one-sided remote DMA
+  (``dl.putmem_signal_nbi`` — the NVSHMEM ``putmem_signal_nbi`` analog),
+  the consumer waits for the arrival signal, reads, and acknowledges.
+* THE classic reuse race, and its fix: DMA receive semaphores accumulate
+  *bytes*, so with one semaphore shared across queue slots, chunk c+1's
+  arrival can satisfy the wait for chunk c and the consumer reads a stale
+  slot. The fix is to index the receive semaphore by slot (here) or epoch
+  parity (``kernels/ll_allgather.py``) — the reference's LL protocol makes
+  the same move by comparing its signal value to the epoch.
+
+Run:  python tutorials/01-distributed-notify-wait.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import force_virtual_mesh  # noqa: E402
+
+force_virtual_mesh(8)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+import triton_distributed_tpu.language as dl  # noqa: E402
+from triton_distributed_tpu.runtime.mesh import make_mesh  # noqa: E402
+from triton_distributed_tpu.runtime.platform import resolve_interpret  # noqa: E402
+
+WORLD = 8
+# Shapes stay small: the virtual-mesh interpreter deadlocks (not errors) when
+# a kernel that blocks on cross-device semaphores allocates any per-device
+# buffer >= 16KB (tests/conftest.py docstring). Real-TPU runs can scale up.
+CHUNK = (4, 128)  # one queue slot; last dim lane-aligned for DMA
+N_CHUNKS = 4      # chunks each producer streams to its consumer
+
+
+def producer_consumer_kernel(x_ref, o_ref, queue, send_sem, recv_sems,
+                             ack_sem, copy_sem):
+    # NOTE the queue lives in HBM (an ANY-space kernel OUTPUT, discarded by
+    # the caller): remote DMAs need a stable HBM landing buffer on the
+    # receiving device — Mosaic has no HBM scratch, and VMEM scratch is not
+    # remotely addressable. This is the symmetric-memory pattern every
+    # kernel in this framework uses (the NVSHMEM symmetric-heap analog).
+    """Every device is BOTH producer (to its right neighbor) and consumer
+    (from its left): rank r streams N_CHUNKS chunks of its input into r+1's
+    2-slot queue, while consuming its left neighbor's stream into o_ref.
+
+    The queue has 2 slots reused N_CHUNKS/2 times each — slot reuse is what
+    makes the ack (flow-control) signal necessary, exactly like the
+    reference's small-queue exercise."""
+    right = dl.remote_rank(1)
+
+    # A barrier before any push: the consumer's queue must be live.
+    dl.barrier_all("tp")
+
+    n_slots = 2
+    for c in range(N_CHUNKS):
+        slot = c % n_slots
+
+        # --- producer side: wait for the slot to be free, then push.
+        if c >= n_slots:
+            # The consumer acks a slot after copying it out; one ack frees
+            # exactly one earlier chunk in this slot.
+            dl.wait(ack_sem, 1)
+        chunk = x_ref.at[pl.ds(c * CHUNK[0], CHUNK[0])]
+        # recv_sems.at[slot]: the PER-SLOT arrival semaphore. A single shared
+        # semaphore would be a race — DMA arrival counts bytes, so chunk
+        # c+1 landing in the other slot could satisfy the wait for chunk c
+        # and the consumer would read a stale slot (observed: rerun this
+        # tutorial with recv_sems.at[0] everywhere and N_CHUNKS large).
+        dma = dl.putmem_signal_nbi(chunk, queue.at[slot], right,
+                                   send_sem, recv_sems.at[slot])
+
+        # --- consumer side: wait for the left neighbor's chunk c.
+        dl.wait_dma_arrival(queue.at[slot], recv_sems.at[slot])
+        cp = pltpu.make_async_copy(
+            queue.at[slot], o_ref.at[pl.ds(c * CHUNK[0], CHUNK[0])], copy_sem)
+        cp.start()
+        cp.wait()
+        # Ack the slot back to the producer (left neighbor = -1).
+        dl.notify(ack_sem, dl.remote_rank(-1))
+
+        dma.wait_send()
+
+    # Drain outstanding acks (the last n_slots chunks are never re-waited):
+    # every signal must be consumed before kernel exit.
+    for _ in range(min(n_slots, N_CHUNKS)):
+        dl.wait(ack_sem, 1)
+
+
+def main():
+    mesh = make_mesh({"tp": WORLD})
+    rows = N_CHUNKS * CHUNK[0]
+    x = jnp.arange(WORLD * rows * CHUNK[1], dtype=jnp.float32
+                   ).reshape(WORLD, rows, CHUNK[1])
+
+    def per_device(xl):
+        out, _queue = pl.pallas_call(
+            producer_consumer_kernel,
+            out_shape=[
+                jax.ShapeDtypeStruct((rows, CHUNK[1]), jnp.float32),
+                jax.ShapeDtypeStruct((2, *CHUNK), jnp.float32),  # queue
+            ],
+            in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+            out_specs=[pl.BlockSpec(memory_space=pl.ANY)] * 2,
+            scratch_shapes=[
+                pltpu.SemaphoreType.DMA(()),              # send
+                pltpu.SemaphoreType.DMA((2,)),            # recv, PER SLOT
+                pltpu.SemaphoreType.REGULAR,              # ack (flow control)
+                pltpu.SemaphoreType.DMA(()),              # local copy
+            ],
+            compiler_params=pltpu.CompilerParams(
+                has_side_effects=True, collective_id=0),
+            # Faithful TPU interpret mode on the virtual mesh; Mosaic-compiled
+            # on real TPU chips (resolve_interpret picks automatically).
+            interpret=resolve_interpret(True),
+        )(xl[0])
+        return out[None]
+
+    out = jax.jit(jax.shard_map(
+        per_device, mesh=mesh, in_specs=P("tp", None, None),
+        out_specs=P("tp", None, None), check_vma=False,
+    ))(x)
+
+    # Rank r consumed rank (r-1)'s stream.
+    np.testing.assert_array_equal(np.asarray(out),
+                                  np.roll(np.asarray(x), 1, axis=0))
+    print("tutorial 01 ok: producer->consumer queue over remote DMA + "
+          "notify/wait/ack signals")
+
+
+if __name__ == "__main__":
+    main()

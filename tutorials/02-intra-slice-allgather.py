@@ -1,0 +1,68 @@
+"""Tutorial 02 — Intra-slice AllGather.
+
+What you learn (TPU edition of the reference's tutorial 02):
+
+* The two intra-slice allgather shapes and when each wins:
+  - ``ring_all_gather``: world-1 neighbor hops; every hop moves one shard
+    over one ICI link, so ALL links carry payload every step — the
+    bandwidth-optimal choice for large messages.
+  - ``a2a_all_gather`` (direct push): every device pushes its shard to all
+    peers at once; one hop of latency, but the (w/2)^2 shard copies crossing
+    the torus bisection share its 2 cut links — latency-optimal for SMALL
+    messages only.
+* ``all_gather(..., method=AllGatherMethod.AUTO)``: dispatch is derived from
+  an analytic perf model of those two effects (``runtime/perf_model.py``) —
+  the analog of the reference's ``get_auto_all_gather_method`` keyed off its
+  NVLink/PCIe topology probe.
+* On GPUs the producer is a copy-engine/NVSHMEM kernel synchronized by
+  signal cells; on TPU each variant is ONE Pallas kernel per device using
+  async remote DMA + per-source semaphores.
+
+Run:  python tutorials/02-intra-slice-allgather.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import force_virtual_mesh  # noqa: E402
+
+force_virtual_mesh(8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.kernels import (  # noqa: E402
+    AllGatherMethod,
+    all_gather,
+)
+from triton_distributed_tpu.kernels.allgather import (  # noqa: E402
+    choose_all_gather_method,
+)
+from triton_distributed_tpu.runtime.mesh import make_mesh  # noqa: E402
+
+WORLD = 8
+
+
+def main():
+    mesh = make_mesh({"tp": WORLD})
+    # Global input: (world, m, d) — device r owns slice [r].
+    x = jnp.arange(WORLD * 4 * 128, dtype=jnp.float32).reshape(WORLD, 4, 128)
+    golden = np.asarray(x).reshape(WORLD * 4, 128)
+
+    for method in (AllGatherMethod.RING_1D, AllGatherMethod.ALL2ALL,
+                   AllGatherMethod.AUTO):
+        out = all_gather(x, mesh=mesh, method=method)
+        np.testing.assert_allclose(np.asarray(out), golden)
+        print(f"  {method.name:8s} ok")
+
+    # The perf-model crossover: small messages -> direct push, large -> ring.
+    small = choose_all_gather_method(WORLD, 1 << 10, num_slices=1)
+    large = choose_all_gather_method(WORLD, 1 << 26, num_slices=1)
+    print(f"  dispatch: 1KB -> {small.name}, 64MB -> {large.name}")
+    assert large is AllGatherMethod.RING_1D
+    print("tutorial 02 ok: ring + direct-push allgather, perf-model dispatch")
+
+
+if __name__ == "__main__":
+    main()

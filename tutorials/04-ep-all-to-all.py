@@ -1,0 +1,76 @@
+"""Tutorial 04 — EP (expert-parallel) AllToAll for MoE inference.
+
+What you learn (TPU edition of the reference's tutorial 04, the DeepSeek-EP
+dispatch/combine — its headline kernel, 137 µs vs DeepEP's 182 µs):
+
+* The MoE dispatch problem: after routing, every device holds, per peer,
+  a variable number of tokens bound for that peer's experts. The whole
+  exchange must be ONE device-side operation (no host round-trip) and move
+  only the occupied rows.
+* ``fast_all_to_all``: a single Pallas kernel per device. Each device
+  pushes, per peer: the split counts (so the receiver knows what arrives)
+  and ceil(splits/chunk_rows) fixed-size row chunks of every payload —
+  predicated async remote DMAs on scalar-prefetched splits. Multiple
+  payloads (tokens + expert ids + scales) ride in one call, like the
+  reference's data/splits/scale triple.
+* Bytes scale with occupancy: at capacity 128 and 10% occupancy the wire
+  carries ~10% of the buffer, not all of it.
+* ``EPAll2AllLayer`` wraps routing + dispatch + combine for a full MoE
+  layer (tutorialized in tests/test_ep_a2a.py).
+
+Run:  python tutorials/04-ep-all-to-all.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import force_virtual_mesh  # noqa: E402
+
+force_virtual_mesh(8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.kernels import AllToAllContext, all_to_all  # noqa: E402
+from triton_distributed_tpu.runtime.mesh import make_mesh  # noqa: E402
+
+WORLD = 8
+
+
+def main():
+    mesh = make_mesh({"ep": WORLD})
+    cap, hidden = 16, 128
+    ctx = AllToAllContext(capacity=cap, hidden=hidden, axis="ep",
+                          chunk_rows=8)
+
+    rng = np.random.default_rng(0)
+    # toks[r][p]: rows rank r wants to send to rank p (capacity-padded).
+    toks = jnp.asarray(
+        rng.standard_normal((WORLD, WORLD, cap, hidden)), jnp.float32)
+    ids = jnp.asarray(rng.integers(0, 64, (WORLD, WORLD, cap, 1)), jnp.int32)
+    # Variable occupancy: rank r sends p rows to peer p (0..7 of 16).
+    counts = jnp.tile(jnp.arange(WORLD, dtype=jnp.int32)[None, :], (WORLD, 1))
+
+    (otoks, oids), rcounts = all_to_all((toks, ids), counts, ctx=ctx,
+                                        mesh=mesh)
+
+    # After the exchange: out[r][p] == in[p][r] on the occupied rows, and
+    # the receiver learned the counts from the wire.
+    np.testing.assert_array_equal(np.asarray(rcounts), np.asarray(counts).T)
+    exp_t = np.transpose(np.asarray(toks), (1, 0, 2, 3))
+    exp_i = np.transpose(np.asarray(ids), (1, 0, 2, 3))
+    for r in range(WORLD):
+        for p in range(WORLD):
+            n = int(np.asarray(rcounts)[r, p])
+            np.testing.assert_allclose(np.asarray(otoks)[r, p, :n],
+                                       exp_t[r, p, :n])
+            np.testing.assert_array_equal(np.asarray(oids)[r, p, :n],
+                                          exp_i[r, p, :n])
+    print("  dispatch ok: multi-payload a2a, counts learned from the wire")
+    print("tutorial 04 ok: single-kernel EP AllToAll with occupancy-scaled "
+          "sends")
+
+
+if __name__ == "__main__":
+    main()

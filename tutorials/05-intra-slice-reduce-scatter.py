@@ -1,0 +1,67 @@
+"""Tutorial 05 — Intra-slice ReduceScatter (and AllReduce).
+
+What you learn (TPU edition of the reference's tutorial 05):
+
+* ``ring_reduce_scatter``: each shard travels the ring accumulating every
+  device's contribution (add-and-forward), ending fully reduced at its
+  owner — bandwidth-optimal, fp32 accumulation regardless of input dtype.
+* ``oneshot_reduce_scatter``: every device pushes its contribution for
+  shard s directly to s's owner, which reduces all arrivals locally in a
+  FIXED global rank order (reduction order must be rank-independent or
+  replicated collectives diverge bitwise between devices).
+* AllReduce built from the same pieces: one-shot (direct exchange) for
+  small/latency-bound messages, fused ring-RS + ring-AG two-shot for
+  bandwidth — the reference's one-/two-shot split (allreduce.py:364/:476);
+  its NVLink-SHARP ``multimem`` variant has no ICI analog, so two-shot
+  covers that regime. Dispatch comes from the analytic perf model.
+
+Run:  python tutorials/05-intra-slice-reduce-scatter.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import force_virtual_mesh  # noqa: E402
+
+force_virtual_mesh(8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.kernels import (  # noqa: E402
+    AllReduceMethod,
+    all_reduce,
+    reduce_scatter,
+)
+from triton_distributed_tpu.runtime.mesh import make_mesh  # noqa: E402
+
+WORLD = 8
+
+
+def main():
+    mesh = make_mesh({"tp": WORLD})
+    rng = np.random.default_rng(0)
+    # (world, world*rows, d): device r contributes slice [r]; after RS,
+    # device r owns rows [r*rows, (r+1)*rows) of the sum.
+    x = jnp.asarray(rng.standard_normal((WORLD, WORLD * 2, 128)), jnp.float32)
+    golden_sum = np.asarray(x).sum(axis=0)
+
+    for method in ("ring", "oneshot", "auto"):
+        out = reduce_scatter(x, mesh=mesh, method=method)
+        np.testing.assert_allclose(np.asarray(out), golden_sum,
+                                   atol=1e-4, rtol=1e-4)
+        print(f"  reduce_scatter {method:7s} ok")
+
+    y = jnp.asarray(rng.standard_normal((WORLD, 16, 128)), jnp.float32)
+    for method in (AllReduceMethod.ONE_SHOT, AllReduceMethod.TWO_SHOT,
+                   AllReduceMethod.AUTO):
+        out = all_reduce(y, mesh=mesh, method=method)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(y).sum(axis=0),
+                                   atol=1e-4, rtol=1e-4)
+        print(f"  all_reduce {method.name:8s} ok")
+    print("tutorial 05 ok: ring/one-shot RS, one-/two-shot AR")
+
+
+if __name__ == "__main__":
+    main()

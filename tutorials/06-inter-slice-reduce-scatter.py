@@ -1,0 +1,63 @@
+"""Tutorial 06 — Inter-slice (DCN) ReduceScatter.
+
+What you learn (TPU edition of the reference's tutorial 06):
+
+* The reference's 2D reduce-scatter (reduce_scatter.py:45): intra-node
+  scatter -> local reduce -> inter-node p2p of same-local-rank segments.
+  The TPU version has the same shape: the intra-slice Pallas ring reduces
+  within each slice over ICI, then same-ici-rank devices across slices
+  finish the reduction over the DCN leg (XLA collective between kernels —
+  DCN has no device-initiated one-sided op).
+* ``reduce_scatter(..., dcn_axis=...)``: AUTO routes to the hierarchical
+  method whenever the mesh has a dcn axis; ``all_reduce_2d`` composes the
+  same two levels for the replicated result.
+
+Run:  python tutorials/06-inter-slice-reduce-scatter.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _bootstrap import force_virtual_mesh  # noqa: E402
+
+force_virtual_mesh(8)
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from triton_distributed_tpu.kernels import (  # noqa: E402
+    all_reduce_2d,
+    reduce_scatter,
+    reduce_scatter_2d,
+)
+from triton_distributed_tpu.runtime.mesh import make_mesh  # noqa: E402
+
+W_DCN, W_ICI = 2, 4
+WORLD = W_DCN * W_ICI
+
+
+def main():
+    mesh = make_mesh({"dcn": W_DCN, "ici": W_ICI}, set_default=False)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((WORLD, WORLD * 2, 128)), jnp.float32)
+    golden = np.asarray(x).sum(axis=0)
+
+    out = reduce_scatter_2d(x, mesh=mesh, ici_axis="ici", dcn_axis="dcn")
+    np.testing.assert_allclose(np.asarray(out), golden, atol=1e-4, rtol=1e-4)
+    print("  reduce_scatter_2d ok")
+
+    out = reduce_scatter(x, mesh=mesh, axis="ici", dcn_axis="dcn")
+    np.testing.assert_allclose(np.asarray(out), golden, atol=1e-4, rtol=1e-4)
+    print("  reduce_scatter AUTO -> 2D ok")
+
+    y = jnp.asarray(rng.standard_normal((WORLD, 12, 128)), jnp.float32)
+    out = all_reduce_2d(y, mesh=mesh, ici_axis="ici", dcn_axis="dcn")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(y).sum(axis=0),
+                               atol=1e-4, rtol=1e-4)
+    print("  all_reduce_2d ok")
+    print("tutorial 06 ok: hierarchical (ICI x DCN) reduce-scatter/allreduce")
+
+
+if __name__ == "__main__":
+    main()

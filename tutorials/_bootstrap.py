@@ -1,0 +1,37 @@
+"""Shared tutorial bootstrap: run anywhere, no cluster needed.
+
+The reference's tutorials require a real multi-GPU box (torchrun +
+NVSHMEM). These tutorials instead force an 8-device *virtual CPU* platform
+(the same recipe as tests/conftest.py) so every distributed kernel runs
+under the faithful Pallas TPU interpreter — remote DMA and semaphores
+simulated per device — on any machine. On a real multi-chip TPU slice the
+same code runs compiled: drop the bootstrap call and build the mesh from
+``jax.devices()``.
+
+Import this FIRST (before jax) in every tutorial:
+
+    from _bootstrap import force_virtual_mesh
+    force_virtual_mesh(8)
+"""
+
+import os
+import re
+import sys
+
+# Tutorials run from a source checkout without installation.
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def force_virtual_mesh(n_devices: int = 8) -> None:
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n_devices}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert len(jax.devices()) == n_devices, (
+        f"virtual mesh has {len(jax.devices())} devices, wanted {n_devices}; "
+        f"import _bootstrap before anything that initializes jax")
