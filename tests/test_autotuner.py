@@ -43,13 +43,34 @@ def test_tuner_picks_fastest_and_caches(monkeypatch):
     assert calls == [1, 2, 3, 1, 2, 3]
 
 
+def test_custom_timer_and_slope():
+    """A custom per-candidate timer overrides perf_thunk, and slope_timer
+    recovers per-iteration cost from a loop(n) callable with constant
+    dispatch overhead added (the overhead must cancel in the slope)."""
+    import time as _time
+
+    tuner = autotuner.ContextualAutotuner(
+        "t", ["a", "b"], timer=lambda loop: loop(1))
+    assert tuner.tune(lambda c: (lambda n: 1.0 if c == "b" else 2.0),
+                      "k1") == "b"
+
+    def loop(n):  # 0.2ms/iter + 5ms constant "dispatch"
+        _time.sleep(0.005 + n * 0.0002)
+        return jnp.zeros(())
+
+    ms = autotuner.slope_timer(loop, rounds=3)
+    assert 0.1 < ms < 0.4, ms
+
+
 def test_disk_cache_survives_memory_clear(monkeypatch, tmp_path):
     monkeypatch.setattr(autotuner, "perf_thunk",
                         lambda thunk, **kw: float(thunk()))
     tuner = autotuner.ContextualAutotuner("d", [7.0, 3.0, 5.0])
     assert tuner.tune(lambda c: (lambda: c), "k") == 3.0
     with open(tmp_path / "tune.json") as f:
-        assert json.load(f) == {"d|k": 1}
+        # Key embeds a digest of the candidate list (stored value is an
+        # index; editing the candidates must invalidate stale indices).
+        assert json.load(f) == {tuner._key("k"): 1}
 
     autotuner.clear_cache()  # memory only; disk remains
     timed = []

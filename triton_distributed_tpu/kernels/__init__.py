@@ -47,7 +47,9 @@ from triton_distributed_tpu.kernels.gemm_reduce_scatter import (  # noqa: F401
 from triton_distributed_tpu.kernels.ep_all_to_all import (  # noqa: F401
     AllToAllContext,
     all_to_all,
+    all_to_all_2d,
     fast_all_to_all,
+    fast_all_to_all_2d,
 )
 from triton_distributed_tpu.kernels.moe_overlap import (  # noqa: F401
     MoEOverlapConfig,

@@ -189,6 +189,12 @@ def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("ag_gemm")),
+        cost_estimate=common.cost_estimate(
+            flops=2 * world * m * k * n_local,
+            bytes_accessed=(2 * world * m * k * a_local.dtype.itemsize
+                            + k * n_local * b_local.dtype.itemsize
+                            + world * m * n_local * out_dtype.itemsize),
+            remote_bytes=(world - 1) * m * k * a_local.dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, a_local, b_local)
     return out

@@ -72,6 +72,20 @@ def compiler_params(collective_id: int | None) -> pltpu.CompilerParams:
     return pltpu.CompilerParams(has_side_effects=True, collective_id=collective_id)
 
 
+def cost_estimate(*, flops: int, bytes_accessed: int,
+                  remote_bytes: int = 0):
+    """Kernel cost metadata for XLA's scheduler and the profiler — the
+    analog of the reference GEMM kernels' ``launch_metadata`` flops/bytes
+    annotations (allgather_gemm.py:132); shows up in XPlane traces
+    (``group_profile``) and informs XLA's async scheduling around the
+    kernel."""
+    from jax.experimental import pallas as pl
+
+    return pl.CostEstimate(flops=int(flops), transcendentals=0,
+                           bytes_accessed=int(bytes_accessed),
+                           remote_bytes_transferred=int(remote_bytes))
+
+
 def local_copy(src_ref, dst_ref, sem):
     """Synchronous local HBM<->VMEM/HBM copy via the DMA engine."""
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)

@@ -247,3 +247,101 @@ def _build_a2a(mesh, ctx, payload_ndims, interpret):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Inter-slice (DCN) leg — hierarchical 2D AllToAll (the reference's a2a
+# crosses nodes through NVSHMEM transports, low_latency_all_to_all.py:36;
+# DCN has no device-initiated op, so the slice hop rides an XLA collective).
+# ---------------------------------------------------------------------------
+
+
+def fast_all_to_all_2d(payloads, send_counts, *, ctx: AllToAllContext,
+                       ici_axis: str = "ici", dcn_axis: str = "dcn",
+                       direction: str = "dispatch", interpret=None):
+    """Per-device 2D EP exchange over a (dcn, ici) mesh.
+
+    ``payloads``: each ``(W_total, capacity, ...)`` with slot p = data for
+    GLOBAL peer p (dcn-major: p = slice * w_ici + local). Two hops:
+
+    1. DCN: one ``lax.all_to_all`` over ``dcn_axis`` between same-ici-rank
+       devices moves each slice-destination block to its target slice (the
+       minimal-traffic direct exchange — every byte crosses DCN once).
+    2. ICI: per source slice, the single-kernel Pallas a2a delivers blocks
+       to their local ranks with occupancy-scaled chunked sends.
+
+    Returns ``(recv_payloads, recv_counts)`` with slot p = from global
+    peer p. Counts ride both hops, so receivers learn exact splits from
+    the wire at every level."""
+    n_slices = jax.lax.axis_size(dcn_axis)
+    ctx_ici = dataclasses.replace(ctx, axis=ici_axis)
+    if n_slices == 1:
+        return fast_all_to_all(payloads, send_counts, ctx=ctx_ici,
+                               direction=direction, interpret=interpret)
+    single = not isinstance(payloads, (tuple, list))
+    payloads = (payloads,) if single else tuple(payloads)
+    w_ici = jax.lax.axis_size(ici_axis)
+    W = n_slices * w_ici
+    for pay in payloads:
+        if pay.shape[0] != W or pay.shape[1] != ctx.capacity:
+            raise ValueError(f"payload {pay.shape} != (world={W}, "
+                             f"capacity={ctx.capacity}, ...)")
+
+    blocks = [p.reshape(n_slices, w_ici, *p.shape[1:]) for p in payloads]
+    counts = jnp.asarray(send_counts, jnp.int32).reshape(n_slices, w_ici)
+
+    # DCN hop: slot s' afterwards = the block slice s' sent to my slice.
+    blocks = [jax.lax.all_to_all(b, dcn_axis, split_axis=0, concat_axis=0)
+              for b in blocks]
+    counts = jax.lax.all_to_all(counts, dcn_axis, split_axis=0,
+                                concat_axis=0)
+
+    # ICI hop, once per source slice (XLA pipelines the independent calls).
+    outs = []
+    rcounts = []
+    for s in range(n_slices):
+        out_s, cnt_s = fast_all_to_all(
+            tuple(b[s] for b in blocks), counts[s], ctx=ctx_ici,
+            direction=direction, interpret=interpret)
+        outs.append(out_s)
+        rcounts.append(cnt_s)
+    merged = tuple(
+        jnp.stack([o[i] for o in outs]).reshape(W, *payloads[i].shape[1:])
+        for i in range(len(payloads)))
+    rcounts = jnp.stack(rcounts).reshape(W)
+    return (merged[0] if single else merged), rcounts
+
+
+def all_to_all_2d(payloads, send_counts, *, ctx: AllToAllContext,
+                  mesh: Mesh | None = None, ici_axis: str = "ici",
+                  dcn_axis: str = "dcn", interpret=None):
+    """Host-level 2D wrapper: payloads ``(W, W, cap, ...)`` (device r owns
+    slice [r], dcn-major ranks); returns routed arrays with
+    out[r][p] = in[p][r]."""
+    mesh = mesh or get_default_mesh()
+    single = not isinstance(payloads, (tuple, list))
+    payloads = (payloads,) if single else tuple(payloads)
+    ndims = tuple(p.ndim for p in payloads)
+    out, counts = _build_a2a_2d(mesh, ctx, ndims, ici_axis, dcn_axis,
+                                interpret)(payloads, send_counts)
+    return (out[0] if single else out), counts
+
+
+@functools.lru_cache(maxsize=None)
+def _build_a2a_2d(mesh, ctx, payload_ndims, ici_axis, dcn_axis, interpret):
+    def f(toks, counts):
+        out, cnts = fast_all_to_all_2d(
+            tuple(t[0] for t in toks), counts[0], ctx=ctx,
+            ici_axis=ici_axis, dcn_axis=dcn_axis, interpret=interpret)
+        return tuple(o[None] for o in out), cnts[None]
+
+    axes = (dcn_axis, ici_axis)
+    pay_spec = tuple(P(axes, *([None] * (nd - 1))) for nd in payload_ndims)
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(pay_spec, P(axes, None)),
+            out_specs=(pay_spec, P(axes, None)),
+            check_vma=False,
+        )
+    )

@@ -216,6 +216,12 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("gemm_rs")),
+        cost_estimate=common.cost_estimate(
+            flops=2 * M * k_local * n,
+            bytes_accessed=(M * k_local * a_local.dtype.itemsize
+                            + world * k_local * n * b_local.dtype.itemsize
+                            + M * n * out_dtype.itemsize),
+            remote_bytes=(world - 1) * m * n * out_dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, a_local, b_local)
     return out

@@ -205,6 +205,15 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("ag_group_gemm")),
+        cost_estimate=common.cost_estimate(
+            flops=2 * world * E * capacity * d * f_local,
+            bytes_accessed=(2 * world * E * capacity * d
+                            * x_local.dtype.itemsize
+                            + E * d * f_local * w_up_local.dtype.itemsize
+                            + world * E * capacity * f_local
+                            * out_dtype.itemsize),
+            remote_bytes=(world - 1) * E * capacity * d
+            * x_local.dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, grid_x, w_up_local)
     return up, state
@@ -355,6 +364,14 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("moe_reduce_rs")),
+        cost_estimate=common.cost_estimate(
+            flops=2 * world * E * capacity * f_local * d,
+            bytes_accessed=(E * rows * f_local * act.dtype.itemsize
+                            + E * f_local * d * w_down_local.dtype.itemsize
+                            + 2 * world * E * capacity * d
+                            * out_dtype.itemsize),
+            remote_bytes=(world - 1) * E * capacity * d
+            * out_dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, act, w_down_local)
     return out

@@ -211,6 +211,14 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("sp_ag_attn")),
+        cost_estimate=common.cost_estimate(
+            flops=4 * H * m * world * m_kv * dh,
+            bytes_accessed=(H * m * dh * q_local.dtype.itemsize
+                            + 4 * world * H * m_kv * dh
+                            * k_local.dtype.itemsize
+                            + H * m * dh * q_local.dtype.itemsize),
+            remote_bytes=2 * (world - 1) * H * m_kv * dh
+            * k_local.dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(scalars, q_local, k_local, v_local)
     if return_partials:
@@ -279,6 +287,151 @@ def _single_device_attn(q, k, v, *, causal: bool, scale: float):
         scores = jnp.where(mask, scores, _NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("hmn,hnd->hmd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Single-device flash prefill
+# ---------------------------------------------------------------------------
+
+
+def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
+                          m_ref, l_ref, *, n_chunks: int, ck: int, lb: int,
+                          g: int, scale: float):
+    """Causal GQA flash prefill for one (batch, kv-head, q-tile): the grid's
+    innermost dim walks KV chunks with streaming-softmax accumulation. Q rows
+    are (Lb query positions x g GQA heads) flattened li-major, so one MXU
+    score block serves the whole GQA group (reference relies on the
+    flash_attn library for this; here it is the flash-decode kernel
+    generalized to q tiles, sharing its masking discipline)."""
+    qb = pl.program_id(2)
+    c = pl.program_id(3)
+    offset = scalars_ref[0]
+    kv_len = scalars_ref[1]
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # Skip chunks fully right of this q tile's last position (causal) or
+    # fully beyond the valid cache (kv_len); the running triple is simply
+    # not updated for them.
+    last_q_pos = offset + qb * lb + lb - 1
+    needed = (c * ck <= last_q_pos) & (c * ck < kv_len)
+
+    @pl.when(needed)
+    def _chunk():
+        q = q_ref[0, 0].astype(jnp.float32)              # (lb*g, dh)
+        k = k_ref[0, 0].astype(jnp.float32)              # (ck, dh)
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ()))) * scale      # (lb*g, ck)
+        rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        q_pos = offset + qb * lb + rows // g
+        key_pos = c * ck + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        valid = (key_pos <= q_pos) & (key_pos < kv_len)
+        scores = jnp.where(valid, scores, _NEG_INF)
+        seg_max = jnp.max(scores, axis=1, keepdims=True)
+        new_max = jnp.maximum(m_ref[...], seg_max)
+        corr = jnp.exp(m_ref[...] - new_max)
+        # `* valid` guard: fully-masked rows otherwise poison the
+        # denominator with exp(0) (same as the decode kernel).
+        p = jnp.exp(scores - new_max) * valid.astype(jnp.float32)
+        l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())))
+        m_ref[...] = new_max
+
+    @pl.when(c == n_chunks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)
+        o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def _q_tile(L: int, g: int, preferred_rows: int = 1024) -> int:
+    """Largest divisor Lb of L with Lb*g sublane-aligned and under the row
+    preference; 0 when none exists (caller falls back to dense)."""
+    best = 0
+    for lb in range(1, L + 1):
+        if L % lb == 0 and (lb * g) % 8 == 0 and lb * g <= preferred_rows:
+            best = lb
+    return best
+
+
+def flash_prefill(q, k_cache, v_cache, *, offset=None, kv_len=None,
+                  scale: float | None = None, chunk: int = 512,
+                  kv_layout: str = "bshd", interpret=None):
+    """Causal GQA prefill attention against a (possibly longer) KV cache via
+    the streaming-softmax Pallas kernel — O(L_q * dh) memory per tile
+    instead of the (B, L, Hq, S) fp32 score tensor of the dense path.
+
+    q: (B, L, Hq, dh) new queries at positions [offset, offset + L);
+    k/v_cache: (B, S, Hkv, dh) (``bshd``, the TP cache layout — transposed
+    once internally; pass ``bhsd`` to skip it) already containing the new
+    keys. ``kv_len`` masks cache positions >= it (default offset + L).
+    Returns (B, L, Hq, dh) in q.dtype.
+
+    Returns None when the shapes don't admit an aligned tiling (ragged L/dh)
+    — callers fall back to the dense jnp path.
+    """
+    B, L, Hq, dh = q.shape
+    if kv_layout == "bshd":
+        k_cache = jnp.swapaxes(k_cache, 1, 2)
+        v_cache = jnp.swapaxes(v_cache, 1, 2)
+    elif kv_layout != "bhsd":
+        raise ValueError(f"unknown kv_layout {kv_layout!r}")
+    _, Hkv, S, _ = k_cache.shape
+    if Hq % Hkv or dh % 128 or S % 8:
+        return None
+    g = Hq // Hkv
+    lb = _q_tile(L, g)
+    if lb == 0:
+        return None
+    scale = dh ** -0.5 if scale is None else scale
+    ck = _kv_chunk(S, chunk)
+    n_chunks = S // ck
+    offset = jnp.asarray(0 if offset is None else offset, jnp.int32)
+    kv_len = jnp.asarray(offset + L if kv_len is None else kv_len, jnp.int32)
+    scalars = jnp.stack([offset, kv_len])
+
+    # Rows li-major: row = li*g + gi -> contiguous q-position tiles.
+    q_r = q.reshape(B, L, Hkv, g, dh).transpose(0, 2, 1, 3, 4
+                                                ).reshape(B, Hkv, L * g, dh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(B, Hkv, L // lb, n_chunks),
+        in_specs=[
+            pl.BlockSpec((1, 1, lb * g, dh),
+                         lambda b, h, qb, c, sc: (b, h, qb, 0)),
+            pl.BlockSpec((1, 1, ck, dh), lambda b, h, qb, c, sc: (b, h, c, 0)),
+            pl.BlockSpec((1, 1, ck, dh), lambda b, h, qb, c, sc: (b, h, c, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, lb * g, dh),
+                               lambda b, h, qb, c, sc: (b, h, qb, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((lb * g, dh), jnp.float32),
+            pltpu.VMEM((lb * g, 1), jnp.float32),
+            pltpu.VMEM((lb * g, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_flash_prefill_kernel, n_chunks=n_chunks, ck=ck,
+                          lb=lb, g=g, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, L * g, dh), q.dtype),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        cost_estimate=common.cost_estimate(
+            flops=4 * B * Hq * L * S * dh,
+            bytes_accessed=(2 * B * Hq * L * dh * q.dtype.itemsize
+                            + 2 * B * Hkv * S * dh
+                            * k_cache.dtype.itemsize)),
+        interpret=resolve_interpret(interpret),
+    )(scalars, q_r, k_cache, v_cache)
+    return out.reshape(B, Hkv, L, g, dh).transpose(0, 2, 1, 3, 4
+                                                   ).reshape(B, L, Hq, dh)
 
 
 # ---------------------------------------------------------------------------
@@ -416,6 +569,12 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
+        cost_estimate=common.cost_estimate(
+            flops=4 * B * Hq * m_kv * dh,
+            bytes_accessed=(B * Hq * dh * q.dtype.itemsize
+                            + 2 * B * Hkv * m_kv * dh
+                            * k_cache.dtype.itemsize
+                            + B * Hq * (dh + 1) * 4)),
         interpret=resolve_interpret(interpret),
     )(kv_len, qg, k_cache, v_cache)
     return out.reshape(B, Hq, dh), lse.reshape(B, Hq)

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from triton_distributed_tpu.kernels.ep_all_to_all import (
     AllToAllContext,
     fast_all_to_all,
+    fast_all_to_all_2d,
 )
 from triton_distributed_tpu.kernels import moe_utils
 
@@ -43,10 +44,35 @@ class EPAll2AllLayer:
     capacity: int            # max tokens per (src, dst) rank pair
     expert_capacity: int     # max tokens per local expert after arrival
     axis: str = "ep"
+    dcn_axis: str | None = None   # set for multi-slice EP: axis = intra-slice
 
     def ctx(self) -> AllToAllContext:
         return AllToAllContext(capacity=self.capacity, hidden=self.hidden,
                                axis=self.axis)
+
+    # EP world/rank span ALL slices when dcn_axis is set (dcn-major global
+    # ranks — the 2D a2a's slot convention).
+    def _world(self) -> int:
+        w = jax.lax.axis_size(self.axis)
+        if self.dcn_axis is not None:
+            w *= jax.lax.axis_size(self.dcn_axis)
+        return w
+
+    def _me(self):
+        me = jax.lax.axis_index(self.axis)
+        if self.dcn_axis is not None:
+            me = (jax.lax.axis_index(self.dcn_axis)
+                  * jax.lax.axis_size(self.axis) + me)
+        return me
+
+    def _a2a(self, payloads, counts, *, direction, interpret):
+        if self.dcn_axis is not None:
+            return fast_all_to_all_2d(
+                payloads, counts, ctx=self.ctx(), ici_axis=self.axis,
+                dcn_axis=self.dcn_axis, direction=direction,
+                interpret=interpret)
+        return fast_all_to_all(payloads, counts, ctx=self.ctx(),
+                               direction=direction, interpret=interpret)
 
     def dispatch(self, x, topk_ids, topk_weights, *, interpret=None):
         """Per-device. x: (n, hidden); topk_ids/weights: (n, topk).
@@ -62,8 +88,8 @@ class EPAll2AllLayer:
         ``state['stats']`` holds ``n_dropped_dispatch`` (this rank's
         routing overflow) and ``n_dropped_expert`` (arrival overflow);
         callers size capacities from those counters (ADVICE r1)."""
-        world = jax.lax.axis_size(self.axis)
-        me = jax.lax.axis_index(self.axis)
+        world = self._world()
+        me = self._me()
         n_local = self.n_experts // world
 
         plan = moe_utils.route_to_ranks(
@@ -71,9 +97,9 @@ class EPAll2AllLayer:
             capacity=self.capacity)
         send, ids = moe_utils.scatter_to_capacity(
             x, plan, world=world, capacity=self.capacity)
-        (recv, recv_ids), rcounts = fast_all_to_all(
-            (send, ids), plan.counts.astype(jnp.int32), ctx=self.ctx(),
-            interpret=interpret)
+        (recv, recv_ids), rcounts = self._a2a(
+            (send, ids), plan.counts.astype(jnp.int32),
+            direction="dispatch", interpret=interpret)
         grouped, expert_counts, src_idx, n_drop_e = (
             moe_utils.tokens_by_local_expert(
                 recv, recv_ids[:, :, 0], rcounts,
@@ -88,11 +114,11 @@ class EPAll2AllLayer:
     def combine(self, expert_out, state, *, interpret=None):
         """Per-device. expert_out: (E_local, expert_cap, hidden).
         Returns (n, hidden): topk-weighted sum per original token."""
-        world = jax.lax.axis_size(self.axis)
+        world = self._world()
         back = moe_utils.scatter_back_from_experts(
             expert_out, state["src_idx"], world=world, capacity=self.capacity)
-        ret, _ = fast_all_to_all(back, state["rcounts"], ctx=self.ctx(),
-                                 direction="combine", interpret=interpret)
+        ret, _ = self._a2a(back, state["rcounts"], direction="combine",
+                           interpret=interpret)
         return moe_utils.gather_from_capacity(
             ret, state["plan"], n_tokens=state["n_tokens"])
 

@@ -74,6 +74,18 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
             q.reshape(B, Hq, dh), k_cache, v_cache, kv_len=offset + 1,
             scale=scale, kv_layout="bshd", interpret=interpret)
         return out.reshape(B, L, Hq, dh).astype(q.dtype)
+    # Prefill (L > 1): the streaming-softmax Pallas kernel — O(tile) memory
+    # instead of the (B, L, Hq, S) fp32 score tensor. Returns None on
+    # shapes with no aligned tiling; fall through to the dense path then.
+    if L > 1 and use_flash_decode:
+        from triton_distributed_tpu.kernels.sp_attention import flash_prefill
+
+        out = flash_prefill(q, k_cache, v_cache, offset=offset,
+                            kv_len=offset + L, scale=scale,
+                            kv_layout="bshd", interpret=interpret)
+        if out is not None:
+            return out
+
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv
     qf = q.astype(jnp.float32).reshape(B, L, Hkv, g, dh)

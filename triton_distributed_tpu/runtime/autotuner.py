@@ -24,6 +24,7 @@ disable tuning entirely (first config wins).
 from __future__ import annotations
 
 import functools
+import hashlib
 import json
 import os
 import statistics
@@ -112,16 +113,26 @@ class ContextualAutotuner:
     the globally-agreed winner; caches by ``key`` in memory and on disk."""
 
     def __init__(self, name: str, configs: Sequence[Any], *,
-                 iters: tuple[int, int] = (8, 24), calls: int = 3):
+                 iters: tuple[int, int] = (8, 24), calls: int = 3,
+                 timer: Callable[[Callable], float] | None = None):
         if not configs:
             raise ValueError("need at least one config")
         self.name = name
         self.configs = list(configs)
         self.iters = iters
         self.calls = calls
+        # Custom ms-estimator for one candidate (overrides perf_thunk) —
+        # used where the thunk shape allows better amortization than
+        # host-looped dispatches (see slope_timer).
+        self.timer = timer
 
     def _key(self, context_key: str) -> str:
-        return f"{self.name}|{context_key}"
+        # The cached value is an INDEX into self.configs: the key must pin
+        # the candidate list, or editing it would silently remap stale
+        # cached indices onto different configs.
+        digest = hashlib.sha256(
+            repr(self.configs).encode()).hexdigest()[:10]
+        return f"{self.name}|{context_key}|{digest}"
 
     def tune(self, make_thunk: Callable[[Any], Callable[[], Any]],
              context_key: str):
@@ -177,8 +188,11 @@ class ContextualAutotuner:
         for cfg in self.configs:
             try:
                 thunk = make_thunk(cfg)
-                timings.append(perf_thunk(thunk, iters=self.iters,
-                                          calls=self.calls))
+                if self.timer is not None:
+                    timings.append(self.timer(thunk))
+                else:
+                    timings.append(perf_thunk(thunk, iters=self.iters,
+                                              calls=self.calls))
             except Exception:
                 timings.append(float("inf"))  # infeasible config loses
         if all(t == float("inf") for t in timings):
@@ -239,12 +253,40 @@ MATMUL_BLOCK_CANDIDATES: tuple[tuple[int, int, int], ...] = (
 )
 
 
+_TUNE_SHORT, _TUNE_LONG = 8, 40
+
+
+def slope_timer(loop, *, rounds: int = 7):
+    """Per-iteration ms of ``loop(n)`` (a jitted fori_loop with static trip
+    count — ONE dispatch per call) via the short/long slope. The previous
+    harness host-looped separate dispatches, whose ~60-100ms tunnel jitter
+    does NOT cancel in the slope and swamped sub-ms candidate gaps (a
+    mis-tune picked a 16-B-pass blocking in r3). Here each sample is
+    exactly two dispatches and the offset subtracts out; min-of-rounds is
+    the least-contended estimate (co-tenant noise is one-sided)."""
+    def run(n):
+        t0 = time.perf_counter()
+        out = loop(n)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) * 1e3
+
+    run(_TUNE_SHORT)
+    run(_TUNE_LONG)  # warm both executables
+    samples = [
+        max((run(_TUNE_LONG) - run(_TUNE_SHORT))
+            / (_TUNE_LONG - _TUNE_SHORT), 1e-6)
+        for _ in range(rounds)
+    ]
+    return min(samples)
+
+
 def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
                         n: int, dtype_str: str):
-    """Shared (m, k, n) block-tuning harness: time an 8x in-jit fori_loop of
-    ``body_of(cfg)(acc, a, b)`` (forced dependence through acc defeats
-    hoisting) per candidate config; contextual-autotuner cached."""
-    tuner = ContextualAutotuner(name, list(candidates), iters=(2, 6))
+    """Shared (m, k, n) block-tuning harness: per candidate, build a jitted
+    variable-trip fori_loop of ``body_of(cfg)(acc, a, b)`` (forced
+    dependence through acc defeats hoisting) and slope-time it
+    (``slope_timer``); contextual-autotuner cached."""
+    tuner = ContextualAutotuner(name, list(candidates), timer=slope_timer)
     dtype = jnp.dtype(dtype_str)
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (m, k), dtype)
@@ -253,14 +295,16 @@ def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
     def make_thunk(cfg):
         body = body_of(cfg)
 
-        @jax.jit
-        def loop(a, b):
+        @functools.partial(jax.jit, static_argnames=("n_iter",))
+        def loop(a, b, n_iter):
             return jax.lax.fori_loop(
-                0, 8, lambda _, acc: body(acc, a, b),
+                0, n_iter, lambda _, acc: body(acc, a, b),
                 jnp.zeros((m, n), jnp.float32))
 
-        loop(a, b).block_until_ready()  # compile check before timing
-        return lambda: loop(a, b)
+        # Compile check before timing — at a trip count slope_timer reuses,
+        # so this warms an executable rather than adding a third compile.
+        loop(a, b, _TUNE_SHORT).block_until_ready()
+        return lambda n_iter: loop(a, b, n_iter)
 
     return tuner.tune(make_thunk, f"{m}x{k}x{n}:{dtype_str}:"
                                   f"{jax.devices()[0].device_kind}")
@@ -302,6 +346,12 @@ def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
 # variants and smaller shapes.
 FUSED_STEP_CANDIDATES: tuple[tuple[int, int, int | None], ...] = (
     (512, 640, None),
+    # Larger block_m cuts whole-B re-reads: B is re-fetched once per m/bm
+    # grid row (the A block's index is constant across the inner j steps, so
+    # Mosaic's pipeline skips its re-fetch). At the bench shape bm=2048
+    # drops HBM traffic from ~408MB to ~212MB per step.
+    (1024, 640, None),
+    (2048, 640, None),
     (1024, 640, 2560),
     (512, 640, 2560),
     (1024, 640, 1024),

@@ -220,6 +220,23 @@ def _spec_flash_decode(mesh):
     return sm, (_sds((B, Hq, dh), jnp.bfloat16), kv, kv)
 
 
+def _spec_flash_prefill(mesh):
+    from triton_distributed_tpu.kernels.sp_attention import flash_prefill
+
+    B, L, Hq, Hkv, dh, S = 8, 1024, 64, 8, 128, 2048  # chunked prefill
+
+    def f(q, k, v):
+        return flash_prefill(q, k, v, offset=jnp.int32(512), interpret=False)
+
+    # Single-device kernel, but the compile must still target the DETACHED
+    # topology (every spec's point): shard the batch over the mesh so the
+    # lowering binds to the topology's devices, not the host's backend.
+    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
+                       out_specs=P("sp"), check_vma=False)
+    kv = _sds((B, S, Hkv, dh), jnp.bfloat16)
+    return sm, (_sds((B, L, Hq, dh), jnp.bfloat16), kv, kv)
+
+
 def _spec_ep_a2a(mesh):
     from triton_distributed_tpu.kernels.ep_all_to_all import (
         AllToAllContext,
@@ -325,6 +342,7 @@ FLAGSHIP_SPECS: dict[str, AOTSpec] = {
         AOTSpec("sp_attention_partials", (("sp", 8),),
                 _spec_sp_attention_partials),
         AOTSpec("flash_decode", (("sp", 8),), _spec_flash_decode),
+        AOTSpec("flash_prefill", (("sp", 8),), _spec_flash_prefill),
         AOTSpec("ep_a2a", (("ep", 8),), _spec_ep_a2a),
         AOTSpec("ll_allgather", (("tp", 8),), _spec_ll_allgather),
         AOTSpec("ring_allgather", (("tp", 8),), _spec_ring_allgather),
