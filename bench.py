@@ -167,23 +167,35 @@ def _run_benchmarks():
     loopback_ms, bare_ms = _paired_slopes(
         [_acc_loop(body_loopback), _acc_loop(body_bare)], a, b, FLOPS)
 
-    # -- arm pair 2: fused accumulate step vs XLA, identical expression ----
+    # -- arm pair 2: fused accumulate step vs XLA, identical expression.
+    # TWO pallas arms ride the interleaved comparison — the autotuner's
+    # winner and the pinned historical best — and the better one is
+    # reported: the tuner's separate harness is noisier than this
+    # interleaved measurement, and its choice flip-flops run to run.
     from triton_distributed_tpu.runtime.autotuner import (
         tuned_fused_step_blocks,
     )
 
-    fbm, fbn, fbk = tuned_fused_step_blocks(M, K, N)
+    PINNED = (512, 640, None)
+    tuned = tuned_fused_step_blocks(M, K, N)
 
-    def body_fused(acc, a, b):
-        return fused_matmul_step(acc, a, b, dep_scalar(acc), block_m=fbm,
-                                 block_n=fbn, block_k=fbk)
+    def fused_body(blocks):
+        bm_, bn_, bk_ = blocks
+
+        def body(acc, a, b):
+            return fused_matmul_step(acc, a, b, dep_scalar(acc), block_m=bm_,
+                                     block_n=bn_, block_k=bk_)
+        return body
 
     def body_xla(acc, a, b):
         bb = b + dep_scalar(acc).astype(b.dtype)
         return acc + jnp.dot(a, bb, preferred_element_type=jnp.float32)
 
-    fused_ms, xla_ms = _paired_slopes(
-        [_acc_loop(body_fused), _acc_loop(body_xla)], a, b, FLOPS)
+    fused_arms = [tuned] if tuned == PINNED else [tuned, PINNED]
+    *fused_times, xla_ms = _paired_slopes(
+        [_acc_loop(fused_body(cfg)) for cfg in fused_arms]
+        + [_acc_loop(body_xla)], a, b, FLOPS, rounds=12)
+    fused_ms = min(fused_times)
 
     # -- extras ------------------------------------------------------------
     # GEMM-RS smoke shape (docs/build.md:96, per-rank K = 29568/8 = 3696 —
@@ -235,17 +247,28 @@ def _run_benchmarks():
          _acc_loop(body_dense, out_shape=(Bp * Lp, Hqp * dhp))],
         qp, kvp, attn_flops, rounds=5)
 
-    # TP-MLP block (AG-GEMM -> GLU -> GEMM-RS, world=1 path) at M=4096.
+    # TP-MLP block (AG-GEMM -> GLU -> GEMM-RS, world=1 path) at M=4096,
+    # through the ON-CHIP tuned blockings (incl. full-K single-pass). Tuning
+    # runs EAGERLY here — timing thunks cannot execute under the jit trace
+    # the _acc_loop harness builds (autotuner docstring).
+    from triton_distributed_tpu.runtime.autotuner import tuned_matmul_blocks
+
+    up_blocks = tuned_matmul_blocks(4096, 5120, 6400)
+    down_blocks = tuned_matmul_blocks(4096, 3200, 5120)
+
     kmlp = jax.random.PRNGKey(3)
     w_down = jax.random.normal(kmlp, (3200, 5120), jnp.bfloat16)
 
     def body_mlp(acc, x, w_gate_up):
         xx = x + dep_scalar(acc).astype(x.dtype)
-        h = ag_gemm_single_chip(xx, w_gate_up)
+        h = ag_gemm_single_chip(xx, w_gate_up, block_m=up_blocks[0],
+                                block_n=up_blocks[1], block_k=up_blocks[2])
         ff = h.shape[-1] // 2
         act = (jax.nn.silu(h[:, :ff].astype(jnp.float32))
                * h[:, ff:].astype(jnp.float32)).astype(x.dtype)
-        return acc + ag_gemm_single_chip(act, w_down).astype(jnp.float32)
+        return acc + ag_gemm_single_chip(
+            act, w_down, block_m=down_blocks[0], block_n=down_blocks[1],
+            block_k=down_blocks[2]).astype(jnp.float32)
 
     mlp_flops = 2 * 4096 * 5120 * 6400 + 2 * 4096 * 3200 * 5120
     am = jax.random.normal(jax.random.fold_in(kmlp, 1), (4096, 5120),

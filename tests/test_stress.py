@@ -169,6 +169,65 @@ def test_stress_ll_allgather_epochs_with_stragglers(mesh8):
             rtol=1e-6)
 
 
+def test_stress_2d_overlap_ops_with_stragglers():
+    """The inter-slice (DCN ring) variants under rank-proportional skew on a
+    (dcn=2, ici=4) mesh: the intra-slice kernels must wait out slow ranks at
+    every ring step and both ops must match the dense goldens."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        AGGEMMConfig,
+        ag_gemm_2d_device,
+    )
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        GEMMRSConfig,
+        gemm_rs_2d_device,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+
+    mesh = make_mesh({"dcn": 2, "ici": 4}, set_default=False)
+    rng = np.random.default_rng(0)
+
+    def skew2d(x):
+        g = (jax.lax.axis_index("dcn") * jax.lax.axis_size("ici")
+             + jax.lax.axis_index("ici"))
+        return straggler_delay(x, g * SKEW_STEPS)
+
+    # AG-GEMM 2D: skew on the A shard.
+    M, K, N = 8 * 4, 16, 8 * 128
+    a = jnp.asarray(rng.standard_normal((M, K)), jnp.float32)
+    b = jnp.asarray(rng.standard_normal((K, N)), jnp.float32)
+
+    def f_ag(al, bl):
+        return ag_gemm_2d_device(skew2d(al), bl, ici_axis="ici",
+                                 dcn_axis="dcn",
+                                 config=AGGEMMConfig(block_n=128))
+
+    out = jax.jit(jax.shard_map(
+        f_ag, mesh=mesh,
+        in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
+        out_specs=P(None, ("dcn", "ici")), check_vma=False))(a, b)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(a) @ np.asarray(b),
+                               atol=1e-3, rtol=1e-3)
+
+    # GEMM-RS 2D: skew on the K-shard operands.
+    M2, K2, N2 = 32, 16 * 8, 128
+    a2 = jnp.asarray(rng.standard_normal((M2, K2)), jnp.float32)
+    b2 = jnp.asarray(rng.standard_normal((K2, N2)), jnp.float32)
+
+    def f_rs(al, bl):
+        return gemm_rs_2d_device(skew2d(al), bl, ici_axis="ici",
+                                 dcn_axis="dcn",
+                                 config=GEMMRSConfig(block_n=128))
+
+    out2 = jax.jit(jax.shard_map(
+        f_rs, mesh=mesh,
+        in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
+        out_specs=P(("dcn", "ici"), None), check_vma=False))(a2, b2)
+    np.testing.assert_allclose(np.asarray(out2),
+                               np.asarray(a2) @ np.asarray(b2),
+                               atol=1e-3, rtol=1e-3)
+
+
 def test_collectives_race_detect(mesh8, capfd):
     """One pass of the collective set under the interpreter's vector-clock
     race detector (InterpretParams(detect_races=True)) — the

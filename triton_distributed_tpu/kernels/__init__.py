@@ -60,4 +60,12 @@ from triton_distributed_tpu.kernels.moe_overlap import (  # noqa: F401
     group_gemm_rs_2d_device,
     group_gemm_rs_device,
 )
+from triton_distributed_tpu.kernels.sp_attention import (  # noqa: F401
+    flash_decode_2d_device,
+    flash_decode_device,
+    flash_decode_local,
+    flash_prefill,
+    sp_ag_attention_2d_device,
+    sp_ag_attention_device,
+)
 from triton_distributed_tpu.kernels import moe_utils  # noqa: F401

@@ -371,9 +371,17 @@ def _fit_block(dim: int, preferred: int, align: int) -> int:
         f"operand to a multiple of {align} or pass an explicit block size")
 
 
-# v5e scoped-VMEM budget Mosaic enforces per kernel; double-buffered in/out
-# blocks + the fp32 accumulator must fit.
-_VMEM_BUDGET = 16 * 2 ** 20
+# Two VMEM ceilings for the single-chip matmul:
+# - AUTO blocks delegate to XLA beyond the conservative budget (ragged
+#   shapes produce full-dim fallback blocks whose true footprint Mosaic may
+#   refuse — the v5e granted ~30MB for a 3696-full-K block and OOM'd; XLA's
+#   emitter handles those shapes at ~98% MFU, so delegation is the design).
+# - EXPLICIT blocks (autotuner candidates) get the raised cap with
+#   ``vmem_limit_bytes`` sized generously; a config Mosaic still refuses
+#   fails compile and loses the tune gracefully. This is what makes aligned
+#   full-K single-pass blockings legal (the hardware has 128MB).
+_AUTO_VMEM_BUDGET = 16 * 2 ** 20
+_VMEM_CAP = 100 * 2 ** 20
 
 
 def _matmul_vmem(bm, bn, bk, in_bytes, out_bytes) -> int:
@@ -410,16 +418,17 @@ def ag_gemm_single_chip(a, b, *, block_m: int | None = None,
     block_n = 640 if block_n is None else block_n
     block_k = 1024 if block_k is None else block_k
     bm, bn, bk = min(block_m, m), min(block_n, n), min(block_k, k)
+    budget = _VMEM_CAP if explicit else _AUTO_VMEM_BUDGET
     if auto_block:
         try:
             bm = _fit_block(m, bm, 8)
             bn = _fit_block(n, bn, 128)
             bk = _fit_block(k, bk, 128)
             if _matmul_vmem(bm, bn, bk, a.dtype.itemsize,
-                            out_dtype.itemsize) > _VMEM_BUDGET:
+                            out_dtype.itemsize) > budget:
                 raise ValueError(
-                    f"blocks ({bm},{bn},{bk}) exceed the {_VMEM_BUDGET >> 20}"
-                    f"MB scoped-VMEM budget")
+                    f"blocks ({bm},{bn},{bk}) exceed the {budget >> 20}"
+                    f"MB VMEM budget")
         except ValueError:
             if explicit:
                 raise
@@ -429,6 +438,10 @@ def ag_gemm_single_chip(a, b, *, block_m: int | None = None,
         raise ValueError(f"shape ({m},{k})x({k},{n}) not divisible by blocks "
                          f"({bm},{bn},{bk})")
     k_tiles = k // bk
+    need = _matmul_vmem(bm, bn, bk, a.dtype.itemsize, out_dtype.itemsize)
+    # Generous headroom: Mosaic's true stack need exceeds the block-math
+    # estimate (observed +18% on a full-K fallback block).
+    vlim = min(need + max(need // 2, 8 * 2 ** 20), _VMEM_CAP)
     return pl.pallas_call(
         functools.partial(_matmul_kernel, k_tiles=k_tiles),
         out_shape=jax.ShapeDtypeStruct((m, n), out_dtype),
@@ -441,6 +454,7 @@ def ag_gemm_single_chip(a, b, *, block_m: int | None = None,
         scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
+            vmem_limit_bytes=vlim,
         ),
         interpret=resolve_interpret(interpret),
     )(a, b)
@@ -538,9 +552,11 @@ def ag_gemm_single_chip_autotuned(a, b, *, interpret=None):
 
     m, k = a.shape
     _, n = b.shape
-    bm, bn, bk = tuned_matmul_blocks(m, k, n, str(a.dtype))
-    return ag_gemm_single_chip(a, b, block_m=bm, block_n=bn, block_k=bk,
-                               interpret=interpret)
+    blocks = tuned_matmul_blocks(m, k, n, str(a.dtype))
+    if blocks is None:  # ragged shape: auto path (delegates to XLA)
+        return ag_gemm_single_chip(a, b, interpret=interpret)
+    return ag_gemm_single_chip(a, b, block_m=blocks[0], block_n=blocks[1],
+                               block_k=blocks[2], interpret=interpret)
 
 
 # ---------------------------------------------------------------------------

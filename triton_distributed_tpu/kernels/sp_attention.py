@@ -588,6 +588,17 @@ def decode_partial_feat(dh: int) -> int:
     return ((dh + 1 + 127) // 128) * 128
 
 
+def _pack_decode_partial(out, lse, dh: int):
+    """The decode-partial WIRE FORMAT: rows [out | lse | lane-pad] of width
+    ``decode_partial_feat(dh)``. One definition — ll_allgather staging and
+    both the 1D and 2D exchanges must agree on it byte-for-byte."""
+    rows = out.shape[0] * out.shape[1]  # (B, H, dh) -> B*H rows
+    feat = decode_partial_feat(dh)
+    return jnp.concatenate(
+        [out.reshape(rows, dh), lse.reshape(rows, 1),
+         jnp.zeros((rows, feat - dh - 1), out.dtype)], axis=-1)
+
+
 def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
                         kv_len=None, scale: float | None = None,
                         ll_staging=None, ll_epoch=None, interpret=None):
@@ -626,9 +637,7 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
             f"decode_partial_feat({dh}) = {feat}; size the staging as "
             f"make_ll_staging((B*H, decode_partial_feat(dh)), ...) — the "
             f"packed (out, lse) rows are lane-padded")
-    packed = jnp.concatenate(
-        [out_local.reshape(B * H, dh), lse_local.reshape(B * H, 1),
-         jnp.zeros((B * H, feat - dh - 1), out_local.dtype)], axis=-1)
+    packed = _pack_decode_partial(out_local, lse_local, dh)
     if ll_staging is not None:
         from triton_distributed_tpu.kernels.ll_allgather import (
             ll_all_gather_device,
@@ -645,3 +654,51 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
     w = jax.nn.softmax(lses, axis=0)[..., None]
     out = jnp.sum(w * outs, axis=0).astype(q.dtype)
     return (out, ll_staging) if ll_staging is not None else out
+
+
+def flash_decode_2d_device(q, k_cache_local, v_cache_local, *,
+                           ici_axis: str = "sp", dcn_axis: str = "dcn",
+                           kv_len=None, scale: float | None = None,
+                           interpret=None):
+    """Inter-slice distributed decode over a (dcn, ici) mesh — the scale-out
+    regime of the reference's flash-decode (its 1->32 GPU scaling crosses
+    nodes, README.md:216-219). The KV sequence is sharded over ALL devices
+    (dcn-major); ``kv_len`` is this device's LOCAL valid cache length.
+
+    Each device computes its split-KV Pallas partial; partials exchange
+    intra-slice through the ring kernel (``flash_decode_device``) producing
+    a slice-level (out, lse) partial pair, which then merges across slices
+    by log-sum-exp over one DCN allgather of the tiny packed rows (decode
+    partials are KB-scale — latency-bound, exactly what the DCN hop wants).
+    """
+    n_slices = jax.lax.axis_size(dcn_axis)
+    if n_slices == 1:
+        return flash_decode_device(q, k_cache_local, v_cache_local,
+                                   axis=ici_axis, kv_len=kv_len, scale=scale,
+                                   interpret=interpret)
+    B, H, dh = q.shape
+    # Intra-slice: local partial + ring exchange, but keep the SLICE partial
+    # mergeable — recover (out_s, lse_s) for this slice by re-merging the
+    # slice's rank partials with their LSEs.
+    world = jax.lax.axis_size(ici_axis)
+    out_local, lse_local = flash_decode_local(
+        q, k_cache_local, v_cache_local, kv_len=kv_len, scale=scale,
+        interpret=interpret)
+    feat = decode_partial_feat(dh)
+    packed = _pack_decode_partial(out_local, lse_local, dh)
+    gathered = ring_all_gather(packed, axis=ici_axis, interpret=interpret)
+    gathered = gathered.reshape(world, B, H, feat)
+    outs, lses = gathered[..., :dh], gathered[..., dh]
+
+    # Slice-level partial: LSE-merged outputs + the slice's combined LSE.
+    w = jax.nn.softmax(lses, axis=0)[..., None]
+    out_s = jnp.sum(w * outs, axis=0)                      # (B, H, dh) fp32
+    lse_s = jax.scipy.special.logsumexp(lses, axis=0)      # (B, H)
+
+    # DCN hop: allgather the slice partials (XLA collective; KB payload).
+    packed_s = _pack_decode_partial(out_s, lse_s, dh)
+    all_s = jax.lax.all_gather(packed_s, dcn_axis)         # (n_slices, ...)
+    all_s = all_s.reshape(n_slices, B, H, feat)
+    outs2, lses2 = all_s[..., :dh], all_s[..., dh]
+    w2 = jax.nn.softmax(lses2, axis=0)[..., None]
+    return jnp.sum(w2 * outs2, axis=0).astype(q.dtype)

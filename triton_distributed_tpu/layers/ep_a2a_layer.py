@@ -31,6 +31,7 @@ from triton_distributed_tpu.kernels.ep_all_to_all import (
     fast_all_to_all_2d,
 )
 from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.runtime.mesh import global_rank, global_world
 
 
 @dataclasses.dataclass(frozen=True)
@@ -51,19 +52,12 @@ class EPAll2AllLayer:
                                axis=self.axis)
 
     # EP world/rank span ALL slices when dcn_axis is set (dcn-major global
-    # ranks — the 2D a2a's slot convention).
+    # ranks — the 2D a2a's slot convention, runtime.mesh.global_rank).
     def _world(self) -> int:
-        w = jax.lax.axis_size(self.axis)
-        if self.dcn_axis is not None:
-            w *= jax.lax.axis_size(self.dcn_axis)
-        return w
+        return global_world(self.axis, self.dcn_axis)
 
     def _me(self):
-        me = jax.lax.axis_index(self.axis)
-        if self.dcn_axis is not None:
-            me = (jax.lax.axis_index(self.dcn_axis)
-                  * jax.lax.axis_size(self.axis) + me)
-        return me
+        return global_rank(self.axis, self.dcn_axis)
 
     def _a2a(self, payloads, counts, *, direction, interpret):
         if self.dcn_axis is not None:

@@ -30,6 +30,7 @@ class SpGQAFlashDecodeAttention:
     num_kv_heads: int
     head_dim: int
     axis: str = "sp"
+    dcn_axis: str | None = None   # multi-slice SP: axis = intra-slice leg
 
     def __post_init__(self):
         if self.num_q_heads % self.num_kv_heads:
@@ -51,11 +52,25 @@ class SpGQAFlashDecodeAttention:
         the staging ``make_ll_staging((B * Hq, decode_partial_feat(dh)),
         jnp.float32, ...)`` — packed partial rows are lane-padded
         (kernels.sp_attention.decode_partial_feat)."""
+        from triton_distributed_tpu.runtime.mesh import global_rank
+
         local_len = None
         if kv_len is not None:
             m_kv = k_cache_local.shape[2]
-            me = jax.lax.axis_index(self.axis)
+            me = global_rank(self.axis, self.dcn_axis)
             local_len = jnp.clip(kv_len - me * m_kv, 0, m_kv)
+        if self.dcn_axis is not None:
+            from triton_distributed_tpu.kernels.sp_attention import (
+                flash_decode_2d_device,
+            )
+
+            if ll_staging is not None:
+                raise NotImplementedError(
+                    "LL fast path is intra-slice only; the DCN hop rides an "
+                    "XLA collective (pass dcn_axis=None or drop ll_staging)")
+            return flash_decode_2d_device(
+                q, k_cache_local, v_cache_local, ici_axis=self.axis,
+                dcn_axis=self.dcn_axis, kv_len=local_len, interpret=interpret)
         return flash_decode_device(q, k_cache_local, v_cache_local,
                                    axis=self.axis, kv_len=local_len,
                                    ll_staging=ll_staging, ll_epoch=ll_epoch,

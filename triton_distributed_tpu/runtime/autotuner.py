@@ -134,6 +134,23 @@ class ContextualAutotuner:
             repr(self.configs).encode()).hexdigest()[:10]
         return f"{self.name}|{context_key}|{digest}"
 
+    def peek(self, context_key: str):
+        """The cached winner for this context, or None — NEVER times or
+        writes; safe under an active jax trace. In MULTI-process runs only
+        the memory cache is consulted: it is written strictly after a
+        collective decision, so it is process-consistent — whereas the disk
+        cache is per-host, and a trace-time read of it could bake DIFFERENT
+        configs into different hosts' jaxprs of one SPMD program (the
+        divergence tune()'s allgather consensus exists to prevent)."""
+        key = self._key(context_key)
+        if key in _memory_cache:
+            return self.configs[_memory_cache[key]]
+        if jax.process_count() == 1:
+            disk = _load_disk_cache()
+            if key in disk and 0 <= disk[key] < len(self.configs):
+                return self.configs[disk[key]]
+        return None
+
     def tune(self, make_thunk: Callable[[Any], Callable[[], Any]],
              context_key: str):
         """Return the winning config for this context (cached).
@@ -250,20 +267,43 @@ MATMUL_BLOCK_CANDIDATES: tuple[tuple[int, int, int], ...] = (
     (512, 640, 512),
     (256, 1024, 512),
     (512, 256, 512),
+    # Full-K single-pass blockings (1<<30 caps to K): no K revisiting, one
+    # accumulator fill per (i, j) tile — legal since ag_gemm_single_chip
+    # sizes vmem_limit_bytes to the working set (the fused-step winner's
+    # shape applied to the plain matmul).
+    (512, 640, 1 << 30),
+    (1024, 640, 1 << 30),
+    (2048, 640, 1 << 30),
 )
 
 
 _TUNE_SHORT, _TUNE_LONG = 8, 40
 
 
+def _trace_state_clean() -> bool:
+    """True when no jax trace is active (timing thunks may run). The check
+    lives in jax's private core module; if a jax upgrade moves it, fail
+    toward "tracing" — the no-tune fallback is always correct (just
+    untuned), while timing under a trace returns tracers and crashes."""
+    try:
+        from jax._src.core import trace_state_clean
+    except Exception:
+        return False
+    return trace_state_clean()
+
+
 def slope_timer(loop, *, rounds: int = 7):
-    """Per-iteration ms of ``loop(n)`` (a jitted fori_loop with static trip
-    count — ONE dispatch per call) via the short/long slope. The previous
-    harness host-looped separate dispatches, whose ~60-100ms tunnel jitter
-    does NOT cancel in the slope and swamped sub-ms candidate gaps (a
-    mis-tune picked a 16-B-pass blocking in r3). Here each sample is
-    exactly two dispatches and the offset subtracts out; min-of-rounds is
-    the least-contended estimate (co-tenant noise is one-sided)."""
+    """Per-iteration ms of ``loop(n)`` — a jitted fori_loop whose trip count
+    is a RUNTIME argument, so short and long runs share ONE executable and
+    one dispatch each; the dispatch offset subtracts out of the slope.
+
+    Two failure modes this design retired (both produced mis-tunes in r3):
+    host-looped separate dispatches (the ~60-100ms tunnel jitter never
+    cancels), and static-trip-count loops (two executables per candidate —
+    the executable-switch stall is SECONDS on the tunnel and swamps any
+    slope). Negative-slope samples are jitter artifacts and are dropped —
+    clamping them small would hand the argmin to the noisiest candidate; a
+    candidate with no valid sample ranks last."""
     def run(n):
         t0 = time.perf_counter()
         out = loop(n)
@@ -271,22 +311,36 @@ def slope_timer(loop, *, rounds: int = 7):
         return (time.perf_counter() - t0) * 1e3
 
     run(_TUNE_SHORT)
-    run(_TUNE_LONG)  # warm both executables
+    run(_TUNE_LONG)  # warm
     samples = [
-        max((run(_TUNE_LONG) - run(_TUNE_SHORT))
-            / (_TUNE_LONG - _TUNE_SHORT), 1e-6)
+        (run(_TUNE_LONG) - run(_TUNE_SHORT)) / (_TUNE_LONG - _TUNE_SHORT)
         for _ in range(rounds)
     ]
-    return min(samples)
+    pos = sorted(x for x in samples if x > 1e-5)
+    if not pos:
+        return float("inf")
+    return pos[len(pos) // 2]
 
 
 def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
                         n: int, dtype_str: str):
-    """Shared (m, k, n) block-tuning harness: per candidate, build a jitted
-    variable-trip fori_loop of ``body_of(cfg)(acc, a, b)`` (forced
-    dependence through acc defeats hoisting) and slope-time it
-    (``slope_timer``); contextual-autotuner cached."""
+    """Shared (m, k, n) block-tuning harness: per candidate, ONE jitted
+    dynamic-trip fori_loop of ``body_of(cfg)(acc, a, b)`` (forced dependence
+    through acc defeats hoisting; runtime trip count = one executable, no
+    switch stalls) slope-timed by ``slope_timer``; contextual-autotuner
+    cached.
+
+    Timing thunks cannot run under an active jax trace (an inner jit
+    INLINES into the outer trace and returns tracers, not timings) — when
+    called while tracing, a cached winner is used if one exists, else the
+    first feasible candidate is returned UNCACHED so a later eager call can
+    tune for real."""
     tuner = ContextualAutotuner(name, list(candidates), timer=slope_timer)
+    context_key = (f"{m}x{k}x{n}:{dtype_str}:"
+                   f"{jax.devices()[0].device_kind}")
+    if not _trace_state_clean():
+        cached = tuner.peek(context_key)
+        return cached if cached is not None else list(candidates)[0]
     dtype = jnp.dtype(dtype_str)
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (m, k), dtype)
@@ -295,26 +349,27 @@ def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
     def make_thunk(cfg):
         body = body_of(cfg)
 
-        @functools.partial(jax.jit, static_argnames=("n_iter",))
+        @jax.jit
         def loop(a, b, n_iter):
             return jax.lax.fori_loop(
                 0, n_iter, lambda _, acc: body(acc, a, b),
                 jnp.zeros((m, n), jnp.float32))
 
-        # Compile check before timing — at a trip count slope_timer reuses,
-        # so this warms an executable rather than adding a third compile.
-        loop(a, b, _TUNE_SHORT).block_until_ready()
-        return lambda n_iter: loop(a, b, n_iter)
+        # Compile check before timing (also the executable every timed call
+        # reuses — n_iter is a runtime arg).
+        loop(a, b, jnp.int32(2)).block_until_ready()
+        return lambda n_iter: loop(a, b, jnp.int32(n_iter))
 
-    return tuner.tune(make_thunk, f"{m}x{k}x{n}:{dtype_str}:"
-                                  f"{jax.devices()[0].device_kind}")
+    return tuner.tune(make_thunk, context_key)
 
 
 @functools.lru_cache(maxsize=None)
 def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
     """On-chip tune of the single-chip matmul blocks at (m, k, n) — the
     consumer GEMM of ag_gemm / gemm_rs (block_n doubles as the overlap
-    kernels' N tile). Returns (bm, bn, bk); cached in memory and on disk."""
+    kernels' N tile). Returns (bm, bn, bk), or None when no candidate
+    divides the shape (callers use the auto-block path, which delegates
+    ragged shapes to XLA); cached in memory and on disk."""
     from triton_distributed_tpu.kernels.allgather_gemm import (
         ag_gemm_single_chip,
     )
@@ -323,7 +378,10 @@ def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
                 if m % min(c[0], m) == 0 and n % min(c[1], n) == 0
                 and k % min(c[2], k) == 0]
     if not feasible:
-        feasible = [(min(1024, m), min(640, n), min(1024, k))]
+        # No candidate divides this shape (ragged dims): None tells the
+        # caller to use the auto-block path, which delegates to XLA's
+        # emitter — forcing a non-dividing block as EXPLICIT would raise.
+        return None
 
     def body_of(cfg):
         bm, bn, bk = (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))

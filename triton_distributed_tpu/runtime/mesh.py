@@ -165,6 +165,29 @@ def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
 
+def global_rank(ici_axis: str, dcn_axis: str | None = None):
+    """This device's GLOBAL rank in the dcn-major convention every 2D
+    component shares (slot p = dcn_index * w_ici + ici_index — the 2D a2a /
+    collective_2d / SP layers all key on it; one definition so a layout
+    change cannot half-propagate). Traced value; call inside shard_map."""
+    import jax
+
+    me = jax.lax.axis_index(ici_axis)
+    if dcn_axis is not None:
+        me = jax.lax.axis_index(dcn_axis) * jax.lax.axis_size(ici_axis) + me
+    return me
+
+
+def global_world(ici_axis: str, dcn_axis: str | None = None) -> int:
+    """Total world across the (dcn, ici) axes; call inside shard_map."""
+    import jax
+
+    w = jax.lax.axis_size(ici_axis)
+    if dcn_axis is not None:
+        w *= jax.lax.axis_size(dcn_axis)
+    return w
+
+
 def ring_neighbors(rank, world: int):
     """(left, right) neighbors on a logical ring — ICI torus wraparound makes
     the logical ring physically contiguous on TPU, the analog of the NVLink
