@@ -95,35 +95,47 @@ def _slope_once(loop, a, b):
     return max((l - s) / (LONG - SHORT), 1e-6)
 
 
-def _paired_slopes(loops, a, b, flops, rounds=8):
+# Arms slower than this are contention artifacts, not kernels: the least
+# compute-dense honest arm (dense-score attention) still sustains ~25 TF/s,
+# while the observed co-tenant bursts drop matmuls to ~6 TF/s for minutes.
+FLOOR_TFLOPS = 10.0
+
+
+def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
     """Lower-quartile plausible slope per arm, sampled INTERLEAVED (arm0,
     arm1, ... per round) so tunnel/thermal drift hits all arms equally and
     cancels from their ratios. The lower quartile (not median) because the
     noise is one-sided: a co-tenant burst only ever INFLATES a sample, so
     the low end of the distribution is the least-contended estimate —
-    applied identically to every arm, ratios stay fair."""
+    applied identically to every arm, ratios stay fair.
+
+    Plausibility is two-sided: faster-than-peak samples are measurement
+    faults, and slower-than-FLOOR_TFLOPS samples are co-tenant bursts (a
+    sustained one once reported a 0.68ms matmul as 21.8ms). If any arm ends
+    a pass with no plausible sample, the whole pass retries after a pause;
+    only after ``retries`` exhausted does the raw median stand in (finite
+    beats breaking the one-JSON-line contract)."""
     for lp in loops:
         _timed(lp, a, b, SHORT)
         _timed(lp, a, b, LONG)  # warm + absorb executable-switch stalls
-    samples = [[] for _ in loops]
-    raw = [[] for _ in loops]
-    for _ in range(rounds):
-        for i, lp in enumerate(loops):
-            ms = _slope_once(lp, a, b)
-            raw[i].append(ms)
-            if flops / ms / 1e9 <= PEAK_TFLOPS:
-                samples[i].append(ms)
+    for attempt in range(retries + 1):
+        samples = [[] for _ in loops]
+        raw = [[] for _ in loops]
+        for _ in range(rounds):
+            for i, lp in enumerate(loops):
+                ms = _slope_once(lp, a, b)
+                raw[i].append(ms)
+                if FLOOR_TFLOPS <= flops / ms / 1e9 <= PEAK_TFLOPS:
+                    samples[i].append(ms)
+        if all(samples):
+            break
+        if attempt < retries:
+            time.sleep(20)  # wait out the burst, then re-measure
 
     def low_quartile(s):
         s = sorted(s)
         return s[max(0, (len(s) - 1) // 4)]
 
-    # Every-sample-rejected arm (sustained measurement faults): fall back
-    # to the raw MEDIAN — the raw samples were rejected for being
-    # implausibly fast, so a central value (not the quartile, which would
-    # pick a near-most-implausible sample) is the least-wrong finite
-    # report, and finite beats an Infinity that breaks the one-JSON-line
-    # output contract.
     return [low_quartile(s) if s else sorted(raw[i])[len(raw[i]) // 2]
             for i, s in enumerate(samples)]
 
@@ -279,6 +291,19 @@ def _run_benchmarks():
     (mlp_ms,) = _paired_slopes(
         [_acc_loop(body_mlp, out_shape=(4096, 5120))], am, bm, mlp_flops)
 
+    # E2E engine decode: Qwen3-1.7B (4B params OOM'd the 16GB chip next to
+    # the bench's other live arrays),
+    # random weights, B=8, 128-token prompt — the WHOLE decode loop runs
+    # as one scanned executable (Engine.serve_scanned), so the per-token
+    # slope between two gen lengths is pure on-chip step time (prefill and
+    # dispatch cancel). Extras-only: the reference e2e numbers are
+    # Qwen3-32B TP=8 on 8xH800 — different model size and chip count.
+    e2e = {}
+    try:
+        e2e = _bench_e2e_decode()
+    except Exception as e:  # noqa: BLE001 — bench must still print its line
+        e2e = {"e2e_error": f"{type(e).__name__}: {str(e)[:120]}"}
+
     print(json.dumps({
         "metric": "ag_gemm_loopback_m4096_qwen32b_tp8_ms",
         "value": round(loopback_ms, 4),
@@ -296,8 +321,44 @@ def _run_benchmarks():
             "flash_prefill_speedup": round(dense_ms / flash_ms, 4),
             "mlp_block_m4096_ms": round(mlp_ms, 4),
             "mlp_vs_h800_baseline": round(BASE_MLP_MS / mlp_ms, 4),
+            **e2e,
         },
     }))
+
+
+def _bench_e2e_decode():
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+
+    config = ModelConfig.from_name("qwen3-1.7b", max_length=512)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="dist",
+                    key=jax.random.PRNGKey(0))
+    B, L0 = 8, 128
+    ids = jnp.ones((B, L0), jnp.int32)
+    g_short, g_long = 8, 40
+
+    def run(gen):
+        t0 = time.perf_counter()
+        out = engine.serve_scanned(ids, gen)
+        jax.block_until_ready(out)
+        return (time.perf_counter() - t0) * 1e3
+
+    run(g_short)
+    run(g_long)  # compile + warm both
+    slopes = [(run(g_long) - run(g_short)) / (g_long - g_short)
+              for _ in range(5)]
+    pos = sorted(s for s in slopes if s > 1e-3)
+    if not pos:
+        return {"e2e_error": "no plausible decode slope"}
+    ms_tok = float(np.median(pos))
+    return {
+        "qwen3_1p7b_b8_decode_ms_per_token": round(ms_tok, 4),
+        "qwen3_1p7b_b8_decode_tokens_per_s": round(B * 1e3 / ms_tok, 1),
+    }
 
 
 if __name__ == "__main__":

@@ -64,6 +64,18 @@ def test_generation_matches_xla_golden(setup, mode, prefill_mode):
     np.testing.assert_array_equal(got, golden)
 
 
+def test_serve_scanned_matches_serve(setup):
+    """The one-executable scanned decode loop (prefill + lax.scan) must
+    generate token-for-token what the per-step loop generates, on both the
+    xla golden and the distributed kernel path."""
+    _, _, _, ids = setup
+    for mode in ("xla", "dist"):
+        e = _engine(setup, mode)
+        np.testing.assert_array_equal(
+            np.asarray(e.serve_scanned(ids, GEN)),
+            np.asarray(e.serve(ids, GEN)), err_msg=mode)
+
+
 def test_kv_cache_offset_advances(setup):
     _, _, _, ids = setup
     e = _engine(setup, "xla")

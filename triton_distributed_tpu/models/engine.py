@@ -67,21 +67,27 @@ class Engine:
 
     # -- compiled step ------------------------------------------------------
 
+    def _make_sm(self, mode: str):
+        """The per-mode shard_map of the model forward — the ONE definition
+        of the step sharding, shared by the per-step jit (``_step_fn``) and
+        the scanned loop (``_serve_scanned_fn``)."""
+        model = self.model
+        kspec, vspec, _ = KVCache.spec(model.axis)
+        return jax.shard_map(
+            functools.partial(model.forward_device, mode=mode,
+                              interpret=self.interpret),
+            mesh=self.mesh,
+            in_specs=(model.param_specs(), P(), kspec, vspec, P()),
+            out_specs=(P(), kspec, vspec),
+            check_vma=False,
+        )
+
     def _step_fn(self, mode: str):
         """jit(shard_map(forward)) for one mode; the decode instance of this
         (L=1 shapes) is the CUDA-Graph-replay analog."""
         if mode in self._steps:
             return self._steps[mode]
-        model, mesh = self.model, self.mesh
-        kspec, vspec, _ = KVCache.spec(model.axis)
-        sm = jax.shard_map(
-            functools.partial(model.forward_device, mode=mode,
-                              interpret=self.interpret),
-            mesh=mesh,
-            in_specs=(model.param_specs(), P(), kspec, vspec, P()),
-            out_specs=(P(), kspec, vspec),
-            check_vma=False,
-        )
+        sm = self._make_sm(mode)
 
         @functools.partial(jax.jit, donate_argnums=(2,))
         def step(params, ids, kv: KVCache):
@@ -152,3 +158,62 @@ class Engine:
                                top_p=self.top_p)
             out.append(tok)
         return jnp.stack(out, axis=1)
+
+    # -- scanned generation (whole decode loop in ONE executable) -----------
+
+    def _serve_scanned_fn(self, gen_len: int, L0: int):
+        """jit of prefill + ``lax.scan`` over the decode steps: one dispatch
+        generates ``gen_len`` tokens. The step-level jit (``_step_fn``) is
+        the CUDA-Graph-replay analog per token; this is the replay LOOP
+        captured too — on a tunneled/host-latency-bound deployment the
+        per-token dispatch (~60-100ms on axon) would otherwise dwarf a
+        sub-ms decode step."""
+        cache_key = ("scan", self.decode_mode, self.prefill_mode, gen_len, L0)
+        if cache_key in self._steps:
+            return self._steps[cache_key]
+        sm_prefill = self._make_sm(self.prefill_mode)
+        sm_decode = self._make_sm(self.decode_mode)
+        temperature, top_p = self.temperature, self.top_p
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def run(params, input_ids, kv: KVCache, key):
+            logits, k, v = sm_prefill(params, input_ids, kv.k, kv.v,
+                                      kv.offset)
+            kv = KVCache(k=k, v=v, offset=kv.offset + input_ids.shape[1])
+            key, sub = jax.random.split(key)
+            tok = sample_token(logits, sub, temperature=temperature,
+                               top_p=top_p)
+
+            def body(carry, _):
+                tok, kv, key = carry
+                logits, k, v = sm_decode(params, tok[:, None], kv.k, kv.v,
+                                         kv.offset)
+                kv = KVCache(k=k, v=v, offset=kv.offset + 1)
+                key, sub = jax.random.split(key)
+                tok = sample_token(logits, sub, temperature=temperature,
+                                   top_p=top_p)
+                return (tok, kv, key), tok
+
+            (_, _, _), toks = jax.lax.scan(
+                body, (tok, kv, key), None, length=gen_len - 1)
+            return jnp.concatenate([tok[:, None], toks.T.astype(jnp.int32)],
+                                   axis=1)
+
+        self._steps[cache_key] = run
+        return run
+
+    def serve_scanned(self, input_ids, gen_len: int, key=None):
+        """``serve`` with the whole prefill + decode loop in one compiled
+        program (tokens match ``serve`` under greedy sampling;
+        tests/test_qwen_e2e.py). Recompiles per (gen_len, prompt length)."""
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        B, L0 = input_ids.shape
+        if gen_len <= 0:
+            return jnp.zeros((B, 0), jnp.int32)
+        if L0 + gen_len > self.max_length:
+            raise ValueError(
+                f"prompt ({L0}) + gen_len ({gen_len}) exceeds max_length "
+                f"({self.max_length})")
+        run = self._serve_scanned_fn(gen_len, L0)
+        return run(self.params, input_ids, self.new_cache(B),
+                   jax.random.PRNGKey(0) if key is None else key)

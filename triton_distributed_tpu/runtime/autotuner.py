@@ -29,6 +29,7 @@ import json
 import os
 import statistics
 import time
+import warnings
 from typing import Any, Callable, Sequence
 
 import jax
@@ -67,6 +68,7 @@ def _store_disk_cache(key: str, value) -> None:
 
 def clear_cache(disk: bool = False) -> None:
     _memory_cache.clear()
+    _blocks_memo.clear()
     if disk:
         try:
             os.remove(_cache_path())
@@ -97,15 +99,20 @@ def perf_thunk(thunk: Callable[[], Any], *, iters: tuple[int, int] = (8, 24),
     return statistics.median(samples)
 
 
-def _vote_across_processes(timings: Sequence[float]) -> int:
+def _vote_across_processes(timings: Sequence[float]) -> tuple[int, bool]:
     """Every process picks argmin of the SAME summed timing vector (the
-    reference's cross-rank all-reduce of timings, autotuner.py:97)."""
+    reference's cross-rank all-reduce of timings, autotuner.py:97). Returns
+    ``(best_index, valid)``; ``valid`` is False when the summed vector is
+    all-inf (every candidate failed or was pure jitter on every process) —
+    also a COLLECTIVE fact, so every process takes the same branch. A
+    single process must never decide 'all failed' locally and skip the
+    allgather: that hangs the processes still voting."""
     t = jnp.asarray(timings, jnp.float32)
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
         t = multihost_utils.process_allgather(t).sum(axis=0)
-    return int(jnp.argmin(t))
+    return int(jnp.argmin(t)), bool(jnp.isfinite(t).any())
 
 
 class ContextualAutotuner:
@@ -212,10 +219,15 @@ class ContextualAutotuner:
                                               calls=self.calls))
             except Exception:
                 timings.append(float("inf"))  # infeasible config loses
-        if all(t == float("inf") for t in timings):
-            raise RuntimeError(
-                f"autotune {key}: every candidate config failed")
-        best = _vote_across_processes(timings)
+        best, valid = _vote_across_processes(timings)
+        if not valid:
+            # Every candidate failed/jittered out on every process — a
+            # transient (e.g. sustained tunnel noise turning all slopes
+            # negative). Use config 0 UNCACHED so a later call re-tunes,
+            # rather than crashing the caller or pinning a noise verdict.
+            warnings.warn(f"autotune {key}: no candidate produced a valid "
+                          f"timing; using config 0 uncached")
+            return self.configs[0]
         _memory_cache[key] = best
         _store_disk_cache(key, best)
         return self.configs[best]
@@ -334,13 +346,20 @@ def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
     INLINES into the outer trace and returns tracers, not timings) — when
     called while tracing, a cached winner is used if one exists, else the
     first feasible candidate is returned UNCACHED so a later eager call can
-    tune for real."""
+    tune for real.
+
+    Returns ``(cfg, committed)``: ``committed`` is False for the
+    trace-fallback and the all-candidates-failed path — CALLERS MUST NOT
+    MEMOIZE an uncommitted result (a plain lru_cache here once pinned the
+    untuned fallback for the process lifetime)."""
     tuner = ContextualAutotuner(name, list(candidates), timer=slope_timer)
     context_key = (f"{m}x{k}x{n}:{dtype_str}:"
                    f"{jax.devices()[0].device_kind}")
     if not _trace_state_clean():
         cached = tuner.peek(context_key)
-        return cached if cached is not None else list(candidates)[0]
+        if cached is not None:
+            return cached, True
+        return list(candidates)[0], False
     dtype = jnp.dtype(dtype_str)
     key = jax.random.PRNGKey(0)
     a = jax.random.normal(key, (m, k), dtype)
@@ -360,10 +379,27 @@ def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
         loop(a, b, jnp.int32(2)).block_until_ready()
         return lambda n_iter: loop(a, b, jnp.int32(n_iter))
 
-    return tuner.tune(make_thunk, context_key)
+    cfg = tuner.tune(make_thunk, context_key)
+    # The no-valid-timing path returns config 0 without writing the tuner
+    # cache; mirror that commit decision to the caller's memo.
+    return cfg, tuner._key(context_key) in _memory_cache
 
 
-@functools.lru_cache(maxsize=None)
+# Per-shape memo for the tuned_* wrappers. NOT functools.lru_cache: only
+# COMMITTED results may be memoized (an uncommitted trace-time fallback must
+# be re-asked so a later eager call tunes for real).
+_blocks_memo: dict = {}
+
+
+def _memoized_blocks(memo_key, compute):
+    if memo_key in _blocks_memo:
+        return _blocks_memo[memo_key]
+    result, committed = compute()
+    if committed:
+        _blocks_memo[memo_key] = result
+    return result
+
+
 def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
     """On-chip tune of the single-chip matmul blocks at (m, k, n) — the
     consumer GEMM of ag_gemm / gemm_rs (block_n doubles as the overlap
@@ -393,9 +429,12 @@ def tuned_matmul_blocks(m: int, k: int, n: int, dtype_str: str = "bfloat16"):
             ).astype(jnp.float32)
         return body
 
-    cfg = _tune_matmul_blocks("matmul_blocks", feasible, body_of, m, k, n,
-                              dtype_str)
-    return (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k))
+    def compute():
+        cfg, committed = _tune_matmul_blocks(
+            "matmul_blocks", feasible, body_of, m, k, n, dtype_str)
+        return (min(cfg[0], m), min(cfg[1], n), min(cfg[2], k)), committed
+
+    return _memoized_blocks(("matmul", m, k, n, dtype_str), compute)
 
 
 # Fused accumulate-step candidates ((bm, bn, bk); bk=None = full K single
@@ -417,7 +456,6 @@ FUSED_STEP_CANDIDATES: tuple[tuple[int, int, int | None], ...] = (
 )
 
 
-@functools.lru_cache(maxsize=None)
 def tuned_fused_step_blocks(m: int, k: int, n: int,
                             dtype_str: str = "bfloat16"):
     """On-chip tune of ``fused_matmul_step`` blocks at (m, k, n):
@@ -435,5 +473,9 @@ def tuned_fused_step_blocks(m: int, k: int, n: int,
                                      block_k=bk)
         return body
 
-    return _tune_matmul_blocks("fused_step_blocks", FUSED_STEP_CANDIDATES,
-                               body_of, m, k, n, dtype_str)
+    def compute():
+        return _tune_matmul_blocks("fused_step_blocks",
+                                   FUSED_STEP_CANDIDATES, body_of, m, k, n,
+                                   dtype_str)
+
+    return _memoized_blocks(("fused", m, k, n, dtype_str), compute)
