@@ -20,6 +20,7 @@ from triton_distributed_tpu.kernels.allreduce import (  # noqa: F401
 )
 from triton_distributed_tpu.kernels.ll_allgather import (  # noqa: F401
     ll_all_gather,
+    ll_all_gather_2d_device,
     ll_all_gather_device,
     make_ll_staging,
 )

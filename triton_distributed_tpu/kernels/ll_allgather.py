@@ -178,3 +178,23 @@ def _build_ll_ag(mesh, axis, interpret, nd):
         ),
         donate_argnums=(1,),
     )
+
+
+def ll_all_gather_2d_device(x_local, staging, epoch, *, ici_axis: str = "ici",
+                            dcn_axis: str = "dcn", interpret=None):
+    """Inter-slice low-latency allgather over a (dcn, ici) mesh — the
+    analog of the reference's inter-node fast-allgather variants
+    (low_latency_allgather.py 2d/3d push kernels). Intra-slice the
+    barrier-free LL kernel runs as-is (persistent staging + epoch parity);
+    the inter-slice hop is one XLA ``all_gather`` over ``dcn_axis`` of the
+    slice-gathered block — latency-critical small messages cross DCN
+    exactly once, already aggregated (w_ici messages ride one DCN
+    transfer). Output is in dcn-major global rank order. Returns
+    (gathered (n_slices*w_ici*m, ...), staging)."""
+    n_slices = jax.lax.axis_size(dcn_axis)
+    intra, staging = ll_all_gather_device(x_local, staging, epoch,
+                                          axis=ici_axis, interpret=interpret)
+    if n_slices == 1:
+        return intra, staging
+    return (jax.lax.all_gather(intra, dcn_axis, axis=0, tiled=True),
+            staging)
