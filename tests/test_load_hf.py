@@ -56,6 +56,47 @@ def test_load_hf_logits_match_transformers(mesh8, hf_checkpoint):
                     msg="load_hf logits vs transformers")
 
 
+def test_load_hf_llama3_logits_match_transformers(mesh8, tmp_path_factory):
+    """The same model stack serves Llama-3 (qk_norm=False, llama3-scaled
+    RoPE): a tiny transformers LlamaForCausalLM with rope_type=llama3 is
+    saved and loaded through load_hf; prefill logits must match the torch
+    reference — verifying the no-qk-norm layout AND the NTK frequency
+    scaling implementation (nn.rope_angles) against HF's."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+        head_dim=8, max_position_embeddings=64, rope_theta=1e4,
+        rms_norm_eps=1e-6, tie_word_embeddings=True, attention_bias=False,
+        mlp_bias=False, torch_dtype="float32",
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 32},
+    )
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(cfg)
+    model.eval()
+    path = tmp_path_factory.mktemp("llama3_tiny_hf")
+    model.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(1).integers(0, 128, (B, L))
+    with torch.no_grad():
+        golden = model(torch.from_numpy(ids)).logits[:, -1].numpy()
+
+    config = ModelConfig.from_name(
+        "tiny", vocab_size=128, d_model=64, n_layers=2, n_heads=8,
+        n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,
+        rope_scaling=(8.0, 1.0, 4.0, 32), tie_embeddings=True,
+        qk_norm=False, dtype=jnp.float32)
+    eng = Engine(config, mesh=mesh8, mode="xla", hf_path=str(path),
+                 block_n=8)
+    logits, _ = eng.prefill(jnp.asarray(ids, jnp.int32), eng.new_cache(B))
+    assert_allclose(logits, golden, atol=2e-3, rtol=2e-3,
+                    msg="llama3 load_hf logits vs transformers")
+
+
 def test_load_hf_roundtrip_packing(mesh8, hf_checkpoint):
     """The loaded pytree has the stacked-layer structure and TP shardings
     init() produces (pack/interleave round-trip sanity)."""

@@ -22,11 +22,26 @@ def rms_norm(x, w, eps: float = 1e-6):
     return (xf * jax.lax.rsqrt(var + eps) * w.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_angles(positions, head_dim: int, theta: float):
+def rope_angles(positions, head_dim: int, theta: float, rope_scaling=None):
     """cos/sin tables for NeoX-style RoPE. positions: (..., L) int ->
-    cos, sin each (..., L, head_dim//2) fp32."""
+    cos, sin each (..., L, head_dim//2) fp32.
+
+    ``rope_scaling``: optional ``(factor, low_freq_factor, high_freq_factor,
+    original_max_position)`` — the Llama-3.1/3.2 frequency-dependent NTK
+    scaling (HF ``rope_type="llama3"``): long-wavelength frequencies divide
+    by ``factor``, short ones stay, the band between interpolates."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32)
                                 / head_dim))
+    if rope_scaling is not None:
+        factor, low_f, high_f, orig_ctx = rope_scaling
+        wavelen = 2.0 * jnp.pi / inv_freq
+        low_wl = orig_ctx / low_f
+        high_wl = orig_ctx / high_f
+        smooth = (orig_ctx / wavelen - low_f) / (high_f - low_f)
+        smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+        inv_freq = jnp.where(wavelen < high_wl, inv_freq,
+                             jnp.where(wavelen > low_wl, inv_freq / factor,
+                                       smoothed))
     ang = positions.astype(jnp.float32)[..., None] * inv_freq
     return jnp.cos(ang), jnp.sin(ang)
 

@@ -61,6 +61,7 @@ class TPAttn:
     axis: str = "tp"
     dtype: jnp.dtype = jnp.bfloat16
     rope_theta: float = 1e6
+    rope_scaling: tuple | None = None   # llama3 NTK scaling (nn.rope_angles)
     qk_norm: bool = True
     rms_eps: float = 1e-6
     block_n: int = 256
@@ -145,7 +146,8 @@ class TPAttn:
             q = nn.rms_norm(q, params["q_norm"], self.rms_eps)
             k = nn.rms_norm(k, params["k_norm"], self.rms_eps)
         positions = offset + jnp.arange(L)
-        cos, sin = nn.rope_angles(positions, dh, self.rope_theta)
+        cos, sin = nn.rope_angles(positions, dh, self.rope_theta,
+                                  self.rope_scaling)
         q = nn.apply_rope(q, cos, sin)
         k = nn.apply_rope(k, cos, sin)
         k_cache = nn.cache_update(k_cache, k, offset)

@@ -4,5 +4,12 @@
 from triton_distributed_tpu.models.config import ModelConfig  # noqa: F401
 from triton_distributed_tpu.models.kv_cache import KVCache  # noqa: F401
 from triton_distributed_tpu.models.qwen import Qwen3  # noqa: F401
+
+# The decoder skeleton (GQA + SwiGLU + RMSNorm, optional per-head qk-norm,
+# plain or llama3-scaled RoPE) serves the Llama-3 family too — presets in
+# ModelConfig ("meta-llama-3-8b", "llama-3.1-8b", ...), HF-name mapping
+# identical minus q_norm/k_norm (verified vs transformers logits,
+# tests/test_load_hf.py).
+Llama3 = Qwen3
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
 from triton_distributed_tpu.models.sampling import sample_token  # noqa: F401

@@ -26,6 +26,9 @@ class ModelConfig:
     head_dim: int = 128
     d_ff: int = 25_600
     rope_theta: float = 1e6
+    # Llama-3.1/3.2 "llama3" RoPE scaling: (factor, low_freq_factor,
+    # high_freq_factor, original_max_position); None = plain RoPE.
+    rope_scaling: tuple | None = None
     rms_eps: float = 1e-6
     tie_embeddings: bool = False
     qk_norm: bool = True
@@ -34,7 +37,7 @@ class ModelConfig:
 
     @classmethod
     def from_name(cls, name: str, **overrides) -> "ModelConfig":
-        key = name.lower().removeprefix("qwen/")
+        key = name.lower().removeprefix("qwen/").removeprefix("meta-llama/")
         if key not in _PRESETS:
             raise ValueError(
                 f"unknown model {name!r}; known: {sorted(_PRESETS)}")
@@ -55,6 +58,27 @@ _PRESETS: dict[str, dict] = {
                       head_dim=128, d_ff=17_408),
     "qwen3-32b": dict(d_model=5120, n_layers=64, n_heads=64, n_kv_heads=8,
                       head_dim=128, d_ff=25_600),
+    # Llama-3 family (same decoder skeleton: GQA + SwiGLU + RMSNorm; no
+    # per-head qk-norm, plain or "llama3"-scaled RoPE). Public HF
+    # config.json values.
+    "meta-llama-3-8b": dict(vocab_size=128_256, d_model=4096, n_layers=32,
+                            n_heads=32, n_kv_heads=8, head_dim=128,
+                            d_ff=14_336, rope_theta=5e5, qk_norm=False,
+                            max_length=8192),
+    "meta-llama-3-70b": dict(vocab_size=128_256, d_model=8192, n_layers=80,
+                             n_heads=64, n_kv_heads=8, head_dim=128,
+                             d_ff=28_672, rope_theta=5e5, qk_norm=False,
+                             max_length=8192),
+    "llama-3.1-8b": dict(vocab_size=128_256, d_model=4096, n_layers=32,
+                         n_heads=32, n_kv_heads=8, head_dim=128,
+                         d_ff=14_336, rope_theta=5e5, qk_norm=False,
+                         rope_scaling=(8.0, 1.0, 4.0, 8192),
+                         max_length=16_384),
+    "llama-3.2-1b": dict(vocab_size=128_256, d_model=2048, n_layers=16,
+                         n_heads=32, n_kv_heads=8, head_dim=64, d_ff=8192,
+                         rope_theta=5e5, qk_norm=False,
+                         rope_scaling=(32.0, 1.0, 4.0, 8192),
+                         tie_embeddings=True, max_length=16_384),
     # Tiny config for tests / virtual-mesh dryruns (not a real checkpoint).
     "tiny": dict(vocab_size=128, d_model=64, n_layers=2, n_heads=8,
                  n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,

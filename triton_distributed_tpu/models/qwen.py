@@ -51,8 +51,8 @@ class Qwen3:
         return TPAttn(d_model=c.d_model, n_heads=c.n_heads,
                       n_kv_heads=c.n_kv_heads, head_dim=c.head_dim,
                       axis=self.axis, dtype=c.dtype, rope_theta=c.rope_theta,
-                      qk_norm=c.qk_norm, rms_eps=c.rms_eps,
-                      block_n=self.block_n)
+                      rope_scaling=c.rope_scaling, qk_norm=c.qk_norm,
+                      rms_eps=c.rms_eps, block_n=self.block_n)
 
     @functools.cached_property
     def mlp(self) -> TPMLP:
