@@ -1,0 +1,80 @@
+#!/usr/bin/env python
+"""AG-GEMM shape sweep — analog of the reference's
+``python/triton_dist/benchmark/bench_allgather_gemm.py`` (230 LoC M-sweep).
+
+Sweeps the token dimension M at the Qwen3-32B TP=8 weight shape and prints
+a table of:
+  loopback_ms  — the full overlap-kernel machinery on one chip
+                 (``ag_gemm_loopback``: HBM staging + per-segment DMA waits
+                 + (segment, n-tile) consumer grid, local DMA standing in
+                 for ICI pushes)
+  matmul_ms    — the bare consumer matmul (no staging machinery)
+  overlap_pct  — matmul_ms / loopback_ms (100% = staging fully hidden)
+  tflops       — loopback effective throughput
+
+Methodology: in-jit fori_loop slope, interleaved arms, two-sided
+plausibility gate — shared with bench.py (see its module docstring).
+
+Usage: python benchmark/bench_ag_gemm.py [--ms 512,1024,2048,4096,8192]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--ms", default="512,1024,2048,4096,8192",
+                   help="comma-separated M values")
+    p.add_argument("--k", type=int, default=5120)
+    p.add_argument("--n", type=int, default=3200)
+    p.add_argument("--segments", type=int, default=8)
+    args = p.parse_args(argv)
+
+    import bench  # repo-root bench: reuse the measurement harness
+
+    bench.PEAK_TFLOPS = bench._peak_tflops()
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_loopback,
+        ag_gemm_single_chip,
+    )
+
+    K, N = args.k, args.n
+    print(f"{'M':>6} {'loopback_ms':>12} {'matmul_ms':>10} "
+          f"{'overlap_pct':>11} {'tflops':>7}")
+    for M in (int(m) for m in args.ms.split(",")):
+        key = jax.random.PRNGKey(M)
+        a = jax.random.normal(key, (M, K), jnp.bfloat16)
+        b = jax.random.normal(jax.random.fold_in(key, 1), (K, N),
+                              jnp.bfloat16)
+        flops = 2 * M * K * N
+
+        def dep(acc):
+            return (acc[0, 0] * 0).astype(jnp.float32)
+
+        def body_loop(acc, a, b):
+            bb = b + dep(acc).astype(b.dtype)
+            return acc + ag_gemm_loopback(
+                a, bb, segments=args.segments).astype(jnp.float32)
+
+        def body_bare(acc, a, b):
+            bb = b + dep(acc).astype(b.dtype)
+            return acc + ag_gemm_single_chip(a, bb).astype(jnp.float32)
+
+        lb_ms, mm_ms = bench._paired_slopes(
+            [bench._acc_loop(body_loop), bench._acc_loop(body_bare)],
+            a, b, flops, rounds=6)
+        print(f"{M:>6} {lb_ms:>12.4f} {mm_ms:>10.4f} "
+              f"{100 * mm_ms / lb_ms:>10.1f}% "
+              f"{flops / lb_ms / 1e9:>7.1f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
