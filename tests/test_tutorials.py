@@ -20,7 +20,7 @@ _TUTORIALS = sorted(
 
 def test_tutorials_exist():
     names = [os.path.basename(t)[:2] for t in _TUTORIALS]
-    assert names == [f"{i:02d}" for i in range(1, 9)], names
+    assert names == [f"{i:02d}" for i in range(1, 11)], names
 
 
 @pytest.mark.parametrize(
