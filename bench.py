@@ -141,9 +141,18 @@ def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
 
 
 def main():
-    # TDT_BENCH_PROFILE=1 wraps the measurement in the group_profile
-    # context (runtime/utils.py — the reference's cross-rank trace-merge
-    # analog); the XPlane trace lands under /tmp/tdtpu_trace/bench.
+    # Persistent XLA compile cache: repeat bench runs (and the driver's
+    # fresh-process run) reuse compiled executables — compile time is never
+    # part of a measurement (every arm warms before timing), this only cuts
+    # wall clock. TDT_BENCH_PROFILE=1 wraps the measurement in the
+    # group_profile context (runtime/utils.py — the reference's cross-rank
+    # trace-merge analog); the XPlane trace lands under /tmp/tdtpu_trace.
+    from triton_distributed_tpu.tools.aot import enable_xla_compilation_cache
+
+    try:
+        enable_xla_compilation_cache()
+    except Exception:
+        pass  # cache dir unwritable: run uncached
     from triton_distributed_tpu.runtime.utils import group_profile
 
     profiling = os.environ.get("TDT_BENCH_PROFILE", "0") == "1"
