@@ -116,6 +116,76 @@ def test_barrier_all(mesh8):
     np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], np.ones(8))
 
 
+def test_putmem_block_and_quiet(mesh8):
+    """Blocking put (local completion on return) + quiet over explicit
+    handles — the nvshmem put/quiet pair (reference test_nvshmem_api.py
+    put family)."""
+
+    def kernel(x_ref, o_ref, send_sem, send_sem2, recv_sem):
+        right = dl.remote_rank(1)
+        # Blocking put: source reusable on return (wait_send inside).
+        dl.putmem_block(x_ref.at[pl.ds(0, 4)], o_ref.at[pl.ds(0, 4)],
+                        right, send_sem, recv_sem)
+        # Non-blocking put drained by quiet (the nvshmem_quiet analog).
+        # NOTE semaphore waits are CONSUMING, not idempotent: quiet is the
+        # one drain of this handle (a second wait would deadlock).
+        dma = dl.putmem_nbi(x_ref.at[pl.ds(4, 4)], o_ref.at[pl.ds(4, 4)],
+                            right, send_sem2, recv_sem)
+        dl.quiet(dma)
+        dl.wait_dma_arrival(o_ref.at[pl.ds(0, 4)], recv_sem)
+        dl.wait_dma_arrival(o_ref.at[pl.ds(4, 4)], recv_sem)
+
+    x = jnp.arange(8 * 8 * 128, dtype=jnp.float32).reshape(8, 8, 128)
+    out = shard_run(
+        kernel, mesh8, x,
+        out_shape=jax.ShapeDtypeStruct((8, 128), jnp.float32),
+        scratch_shapes=[pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(()),
+                        pltpu.SemaphoreType.DMA(())],
+        collective_id=4,
+    )
+    assert_allclose(out, np.roll(np.asarray(x), shift=1, axis=0))
+
+
+def test_signal_op_wait_until(mesh8):
+    """The nvshmem signal_op / signal_wait_until handshake on a REGULAR
+    semaphore: every device raises its LEFT neighbor's signal by 3 and
+    waits until its own reaches 3 (reference test_nvshmem_api.py signal
+    family)."""
+
+    def kernel(x_ref, o_ref, sig):
+        left = dl.remote_rank(-1)
+        dl.signal_op(sig, left, inc=3)
+        dl.signal_wait_until(sig, 3)
+        assert dl.fence() is None  # ordering is explicit waits; fence no-op
+        o_ref[0, 0] = dl.rank("tp") + 1
+
+    x = jnp.zeros((8, 1), jnp.int32)
+    out = shard_run(
+        kernel, mesh8, x, out_shape=jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        scratch_shapes=[pltpu.SemaphoreType.REGULAR],
+        collective_id=5, out_space=pltpu.VMEM,
+    )
+    np.testing.assert_array_equal(np.asarray(out)[:, 0, 0], np.arange(8) + 1)
+
+
+def test_my_pe_n_pes_remote_rank(mesh8):
+    def kernel(x_ref, o_ref):
+        o_ref[0, 0] = dl.my_pe("tp")
+        o_ref[0, 1] = dl.n_pes("tp")
+        o_ref[0, 2] = dl.remote_rank(3)
+
+    x = jnp.zeros((8, 1), jnp.int32)
+    out = shard_run(
+        kernel, mesh8, x, out_shape=jax.ShapeDtypeStruct((1, 3), jnp.int32),
+        out_space=pltpu.VMEM,
+    )
+    out = np.asarray(out)
+    np.testing.assert_array_equal(out[:, 0, 0], np.arange(8))
+    np.testing.assert_array_equal(out[:, 0, 1], np.full(8, 8))
+    np.testing.assert_array_equal(out[:, 0, 2], (np.arange(8) + 3) % 8)
+
+
 def test_signal_add_only():
     with pytest.raises(NotImplementedError):
         dl.notify(None, 0, sig_op=dl.SIGNAL_SET)
