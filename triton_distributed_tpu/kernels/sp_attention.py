@@ -349,6 +349,24 @@ def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
         o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
+def prefill_alignment_issue(L: int, Hq: int, dh: int, Hkv: int,
+                            S: int) -> str | None:
+    """Why ``flash_prefill`` would return None for these shapes, as a
+    human-readable string naming the offending dim — or None when the shapes
+    tile fine. This IS ``flash_prefill``'s shape gate (single source of
+    truth), phrased for the dense-fallback warning in layers/nn.py."""
+    if Hq % Hkv:
+        return f"Hq={Hq} not a multiple of Hkv={Hkv}"
+    if dh % 128:
+        return f"head_dim={dh} not a multiple of 128 (lane width)"
+    if S % 8:
+        return f"cache len S={S} not a multiple of 8 (sublane width)"
+    if _q_tile(L, Hq // Hkv) == 0:
+        return (f"q len L={L} admits no sublane-aligned tile "
+                f"(need a divisor lb with lb*{Hq // Hkv} % 8 == 0)")
+    return None
+
+
 def _q_tile(L: int, g: int, preferred_rows: int = 1024) -> int:
     """Largest divisor Lb of L with Lb*g sublane-aligned and under the row
     preference; 0 when none exists (caller falls back to dense)."""
@@ -382,12 +400,10 @@ def flash_prefill(q, k_cache, v_cache, *, offset=None, kv_len=None,
     elif kv_layout != "bhsd":
         raise ValueError(f"unknown kv_layout {kv_layout!r}")
     _, Hkv, S, _ = k_cache.shape
-    if Hq % Hkv or dh % 128 or S % 8:
+    if prefill_alignment_issue(L, Hq, dh, Hkv, S) is not None:
         return None
     g = Hq // Hkv
     lb = _q_tile(L, g)
-    if lb == 0:
-        return None
     scale = dh ** -0.5 if scale is None else scale
     ck = _kv_chunk(S, chunk)
     n_chunks = S // ck

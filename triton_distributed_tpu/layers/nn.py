@@ -14,6 +14,36 @@ import jax.numpy as jnp
 
 _NEG_INF = float("-inf")
 
+# Score tensors at or above this element count (B*L*Hq*S — what the dense
+# path actually materializes) are exactly the long-context OOM flash_prefill
+# exists to avoid — warn (once per shape) when a ragged shape silently sends
+# such a prefill down the dense path.
+_DENSE_FALLBACK_WARN_ELEMS = 1 << 22
+_warned_dense_shapes: set = set()
+
+
+def _warn_dense_fallback(B, L, Hq, dh, S, Hkv):
+    if B * L * Hq * S < _DENSE_FALLBACK_WARN_ELEMS:
+        return
+    key = (B, L, Hq, dh, S, Hkv)
+    if key in _warned_dense_shapes:
+        return
+    _warned_dense_shapes.add(key)
+    from triton_distributed_tpu.kernels.sp_attention import (
+        prefill_alignment_issue,
+    )
+
+    import warnings
+
+    reason = prefill_alignment_issue(L, Hq, dh, Hkv, S) or "unknown"
+    warnings.warn(
+        f"flash_prefill cannot tile this shape ({reason}); falling back to "
+        f"the dense attention path, which materializes a "
+        f"({B}, {L}, {Hkv}, {Hq // Hkv}, {S}) fp32 score tensor "
+        f"({B * L * Hq * S * 4 / 2**30:.2f} GiB) — pad L/S/head_dim to "
+        f"aligned sizes to avoid this at long context.",
+        stacklevel=3)
+
 
 def rms_norm(x, w, eps: float = 1e-6):
     """RMSNorm over the last dim, fp32 math, cast back to x.dtype."""
@@ -100,6 +130,8 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
                             kv_layout="bshd", interpret=interpret)
         if out is not None:
             return out
+        _warn_dense_fallback(B, L, Hq, dh, k_cache.shape[1],
+                             k_cache.shape[2])
 
     S, Hkv = k_cache.shape[1], k_cache.shape[2]
     g = Hq // Hkv

@@ -52,8 +52,10 @@ def _load_lib(build: bool = True):
         return _lib
     if not os.path.exists(_SO) and build:
         # Serialize the build across processes: a multi-process job calls
-        # load_hf on every host process at startup, and concurrent `make`s
-        # write the .so in place — a loser could dlopen a half-written file.
+        # load_hf on every host process at startup. The Makefile links to a
+        # temp path and mv's it into place, so even a process that skips
+        # this block (exists() raced true) can only dlopen a COMPLETE .so —
+        # rename(2) is atomic; the flock just avoids duplicate compiles.
         try:
             os.makedirs(os.path.dirname(_SO), exist_ok=True)
             import fcntl
