@@ -18,8 +18,24 @@ Extras:
   acc + a @ (b + s), everything fused in-kernel) against XLA compiling the
   IDENTICAL per-iteration expression — same semantics, both sides free to
   fuse. Bar: <= 1.0 (VERDICT r2 weak #1).
+- ``gemm_rs_overlap_efficiency``: same pairing for the GEMM-RS loopback
+  (per-tile push/fold machinery vs identical-FLOPs bare matmul).
+- ``a2a_dispatch_loopback_us``: the EP AllToAll protocol at the reference
+  headline config (cap 128, hidden 7168, fp8 + f32 scales) through local
+  DMA — machinery latency floor (reference: 137 µs with real RDMA on 32
+  GPUs, README.md:97).
+- ``flash_decode_b128_16k_ms`` (+ ``flash_decode_hbm_frac``): split-KV
+  decode at Qwen3-32B shapes; HBM-bound, so the sanity bar is fraction of
+  HBM peak.
 - the GEMM-RS build-doc smoke shape (8192x8192x29568 TP=8 -> per-rank K
-  3696, docs/build.md:96) and the TP-MLP block at M=4096 (e2e_dense.md:19).
+  3696, docs/build.md:96) measured BOTH ways (XLA delegation vs padded-K
+  Pallas; ``ragged_k_best`` names the winner), the TP-MLP block at M=4096
+  (e2e_dense.md:19), and the M=128 AR-mode pair (``mlp_m128_*``,
+  e2e_dense.md:33-37) with the one-shot-AR machinery priced in via
+  ``oneshot_ar_loopback``.
+- ``aot_step_*``: engine decode-step cold start, trace+compile vs
+  serialized-executable deserialize (``AOTExecutableCache``).
+- ``qwen3_4b_*``: standalone-subprocess e2e decode (fresh HBM).
 
 Methodology (validated rounds 2-3; see tools/sweep_matmul.py): the axon TPU
 tunnel adds ~60-100 ms per-dispatch latency and drifts, so each op is
@@ -57,6 +73,20 @@ def _peak_tflops() -> float:
         if tag in kind:
             return peak * 1.02
     return 1000.0
+
+
+def _hbm_gbps() -> float:
+    """Per-chip HBM bandwidth (GB/s) for the roofline bounds of the
+    DMA/HBM-bound arms (a2a latency, flash decode). Same spirit as
+    ``_peak_tflops``: public speeds-and-feeds per device kind."""
+    kind = jax.devices()[0].device_kind.lower()
+    rates = {"v5 lite": 819.0, "v5lite": 819.0, "v5e": 819.0,
+             "v4": 1228.0, "v5p": 2765.0, "v5": 2765.0,
+             "v6 lite": 1640.0, "v6e": 1640.0}
+    for tag, r in rates.items():
+        if tag in kind:
+            return r
+    return 3500.0
 
 
 PEAK_TFLOPS = None  # resolved lazily in main (needs a live backend)
@@ -101,7 +131,7 @@ def _slope_once(loop, a, b):
 FLOOR_TFLOPS = 10.0
 
 
-def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
+def _paired_slopes(loops, a, b, flops, rounds=8, retries=2, ms_bounds=None):
     """Lower-quartile plausible slope per arm, sampled INTERLEAVED (arm0,
     arm1, ... per round) so tunnel/thermal drift hits all arms equally and
     cancels from their ratios. The lower quartile (not median) because the
@@ -111,10 +141,14 @@ def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
 
     Plausibility is two-sided: faster-than-peak samples are measurement
     faults, and slower-than-FLOOR_TFLOPS samples are co-tenant bursts (a
-    sustained one once reported a 0.68ms matmul as 21.8ms). If any arm ends
-    a pass with no plausible sample, the whole pass retries after a pause;
-    only after ``retries`` exhausted does the raw median stand in (finite
-    beats breaking the one-JSON-line contract)."""
+    sustained one once reported a 0.68ms matmul as 21.8ms). Arms that are
+    DMA/HBM-bound rather than MXU-bound pass explicit ``ms_bounds``
+    (lo, hi) instead — their honest TF/s sits below FLOOR_TFLOPS, so the
+    FLOPs gate would reject every real sample (lo from the roofline:
+    nothing moves bytes faster than HBM). If any arm ends a pass with no
+    plausible sample, the whole pass retries after a pause; only after
+    ``retries`` exhausted does the raw median stand in (finite beats
+    breaking the one-JSON-line contract)."""
     for lp in loops:
         _timed(lp, a, b, SHORT)
         _timed(lp, a, b, LONG)  # warm + absorb executable-switch stalls
@@ -125,7 +159,11 @@ def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
             for i, lp in enumerate(loops):
                 ms = _slope_once(lp, a, b)
                 raw[i].append(ms)
-                if FLOOR_TFLOPS <= flops / ms / 1e9 <= PEAK_TFLOPS:
+                if ms_bounds is not None:
+                    ok = ms_bounds[0] <= ms <= ms_bounds[1]
+                else:
+                    ok = FLOOR_TFLOPS <= flops / ms / 1e9 <= PEAK_TFLOPS
+                if ok:
                     samples[i].append(ms)
         if all(samples):
             break
@@ -141,18 +179,36 @@ def _paired_slopes(loops, a, b, flops, rounds=8, retries=2):
 
 
 def main():
-    # Persistent XLA compile cache: repeat bench runs (and the driver's
-    # fresh-process run) reuse compiled executables — compile time is never
-    # part of a measurement (every arm warms before timing), this only cuts
-    # wall clock. TDT_BENCH_PROFILE=1 wraps the measurement in the
-    # group_profile context (runtime/utils.py — the reference's cross-rank
-    # trace-merge analog); the XPlane trace lands under /tmp/tdtpu_trace.
+    # Persistent XLA compile cache FIRST — the --e2e-only child must reuse
+    # cached executables too (a cold 4B-model compile against the tunnel
+    # costs minutes and risks the subprocess timeout).
     from triton_distributed_tpu.tools.aot import enable_xla_compilation_cache
 
     try:
         enable_xla_compilation_cache()
     except Exception:
         pass  # cache dir unwritable: run uncached
+
+    # --e2e-only <model>: child-process mode for the standalone e2e arm
+    # (fresh HBM; see _bench_e2e_subprocess). Prints ONE JSON dict of
+    # extras and exits.
+    import sys
+
+    if "--e2e-only" in sys.argv:
+        global PEAK_TFLOPS
+        PEAK_TFLOPS = _peak_tflops()
+        model = sys.argv[sys.argv.index("--e2e-only") + 1]
+        try:
+            print(json.dumps(_bench_e2e_decode(model, with_aot=False)))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps(
+                {f"{model}_error": f"{type(e).__name__}: {str(e)[:120]}"}))
+        return
+    # TDT_BENCH_PROFILE=1 wraps the measurement in the group_profile
+    # context (runtime/utils.py — the reference's cross-rank trace-merge
+    # analog); the XPlane trace lands under /tmp/tdtpu_trace. Compile time
+    # is never part of a measurement (every arm warms before timing); the
+    # cache above only cuts wall clock.
     from triton_distributed_tpu.runtime.utils import group_profile
 
     profiling = os.environ.get("TDT_BENCH_PROFILE", "0") == "1"
@@ -174,30 +230,47 @@ def _run_benchmarks():
     b = jax.random.normal(jax.random.fold_in(key, 1), (K, N), jnp.bfloat16)
 
     def dep_scalar(acc):
-        return (acc[0, 0] * 0).astype(jnp.float32)
+        # Epsilon (not *0) so no simplifier pass can ever fold the
+        # dependence and hoist the loop body (a *0 dep DID get folded in a
+        # round-4 side harness); 1e-24 is a no-op in bf16/f32 adds.
+        return (acc[0, 0] * 1e-24).astype(jnp.float32)
 
-    # -- arm pair 1: overlap machinery vs bare consumer matmul -------------
+    # -- arm trio 1: overlap machinery vs bare consumer matmul -------------
+    # The middle arm (segmented bare: identical consumer grid, no staging)
+    # decomposes the overlap gap into grid-structure cost vs staging
+    # machinery cost (VERDICT r3 next #2).
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_segmented_bare,
+    )
+
     def body_loopback(acc, a, b):
         bb = b + dep_scalar(acc).astype(b.dtype)
         return acc + ag_gemm_loopback(a, bb, segments=8).astype(jnp.float32)
+
+    def body_segbare(acc, a, b):
+        bb = b + dep_scalar(acc).astype(b.dtype)
+        return acc + ag_gemm_segmented_bare(a, bb, segments=8
+                                            ).astype(jnp.float32)
 
     def body_bare(acc, a, b):
         bb = b + dep_scalar(acc).astype(b.dtype)
         return acc + ag_gemm_single_chip(a, bb).astype(jnp.float32)
 
-    loopback_ms, bare_ms = _paired_slopes(
-        [_acc_loop(body_loopback), _acc_loop(body_bare)], a, b, FLOPS)
+    loopback_ms, segbare_ms, bare_ms = _paired_slopes(
+        [_acc_loop(body_loopback), _acc_loop(body_segbare),
+         _acc_loop(body_bare)], a, b, FLOPS)
+    ag_staging_bound_ms = 2 * 7 * (M // 8) * K * 2 / _hbm_gbps() / 1e6
 
     # -- arm pair 2: fused accumulate step vs XLA, identical expression.
-    # TWO pallas arms ride the interleaved comparison — the autotuner's
-    # winner and the pinned historical best — and the better one is
-    # reported: the tuner's separate harness is noisier than this
-    # interleaved measurement, and its choice flip-flops run to run.
+    # The tuner's winner rides alone: since the tuner itself samples
+    # candidates interleaved with a lower-quartile estimate
+    # (runtime/autotuner.interleaved_slope_timer), its choice is stable
+    # run-to-run and the r3 two-arm pinned-config hedge is gone
+    # (VERDICT r3 weak #4).
     from triton_distributed_tpu.runtime.autotuner import (
         tuned_fused_step_blocks,
     )
 
-    PINNED = (512, 640, None)
     tuned = tuned_fused_step_blocks(M, K, N)
 
     def fused_body(blocks):
@@ -212,11 +285,124 @@ def _run_benchmarks():
         bb = b + dep_scalar(acc).astype(b.dtype)
         return acc + jnp.dot(a, bb, preferred_element_type=jnp.float32)
 
-    fused_arms = [tuned] if tuned == PINNED else [tuned, PINNED]
-    *fused_times, xla_ms = _paired_slopes(
-        [_acc_loop(fused_body(cfg)) for cfg in fused_arms]
-        + [_acc_loop(body_xla)], a, b, FLOPS, rounds=12)
-    fused_ms = min(fused_times)
+    fused_ms, xla_ms = _paired_slopes(
+        [_acc_loop(fused_body(tuned)), _acc_loop(body_xla)], a, b, FLOPS,
+        rounds=12)
+
+    # -- arm pair 3: GEMM-RS overlap machinery vs bare matmul --------------
+    # (VERDICT r3 missing #1: the GEMM-RS family's first hardware number.)
+    # Loopback at the M=4096 Qwen3-32B TP=8 down-proj shape: per-device
+    # (4096, 3200) x (3200, 5120), 8 segments — per-tile push-as-computed
+    # partials through HBM staging with parity double-buffering, local DMA
+    # standing in for ICI. Bare twin: the identical-FLOPs full matmul.
+    # Roofline note: the unhidden bound for the staging traffic is
+    # 2 * (7/8) * M * N * 2B (push write + fold read-back) over HBM bw.
+    from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
+        gemm_rs_loopback,
+    )
+
+    from triton_distributed_tpu.runtime.autotuner import tuned_matmul_blocks
+
+    Mr, Kr, Nr = 4096, 3200, 5120
+    ar = jax.random.normal(jax.random.fold_in(key, 8), (Mr, Kr), jnp.bfloat16)
+    br = jax.random.normal(jax.random.fold_in(key, 9), (Kr, Nr), jnp.bfloat16)
+    rs_flops = 2 * Mr * Kr * Nr
+    # The bare twin runs at ITS tuned blocks — an untuned bare arm once
+    # made the loopback look >1.0 "efficient", which only means the
+    # comparison was unfair, not that staging is free.
+    rs_bare_blocks = tuned_matmul_blocks(Mr, Kr, Nr)
+
+    def body_rs_loopback(acc, a, b):
+        bb = b + dep_scalar(acc).astype(b.dtype)
+        return acc + gemm_rs_loopback(a, bb, segments=8).astype(jnp.float32)
+
+    def body_rs_bare(acc, a, b):
+        bb = b + dep_scalar(acc).astype(b.dtype)
+        if rs_bare_blocks is None:
+            return acc + ag_gemm_single_chip(a, bb).astype(jnp.float32)
+        return acc + ag_gemm_single_chip(
+            a, bb, block_m=rs_bare_blocks[0], block_n=rs_bare_blocks[1],
+            block_k=rs_bare_blocks[2]).astype(jnp.float32)
+
+    rs_loop_ms, rs_bare_ms = _paired_slopes(
+        [_acc_loop(body_rs_loopback, out_shape=(Mr // 8, Nr)),
+         _acc_loop(body_rs_bare)], ar, br, rs_flops)
+    rs_staging_bound_ms = (2 * 7 * (Mr // 8) * Nr * 2) / _hbm_gbps() / 1e6
+
+    # -- EP AllToAll dispatch latency (loopback) ---------------------------
+    # Reference headline config: capacity 128 tokens/rank, hidden 7168, fp8
+    # tokens + f32 scales (137 µs on 32xH800 with real RDMA, README.md:97).
+    # The loopback runs the full protocol — count cells, occupancy-chunked
+    # payload DMAs, SMEM count readback, predicated waits — through the
+    # local DMA engine at world=8, full occupancy: the machinery-latency
+    # floor without ICI wire time. Gated by HBM roofline bounds, not FLOPs
+    # (it is pure DMA).
+    from triton_distributed_tpu.kernels.ep_all_to_all import (
+        AllToAllContext,
+        a2a_loopback,
+    )
+
+    # chunk_rows=capacity: at the headline's FULL occupancy the reference
+    # moves each (peer, payload) in ONE exact-split putmem
+    # (low_latency_all_to_all.py:36); the equivalent DMA granularity here
+    # is one capacity-sized chunk — the occupancy-scaled chunking (and its
+    # predicated waits) still runs, it just resolves to a single chunk.
+    a2a_ctx = AllToAllContext(capacity=128, hidden=7168, chunk_rows=128)
+    a2a_world = 8
+    toks = jax.random.normal(
+        jax.random.fold_in(key, 10), (a2a_world, 128, 7168), jnp.float32
+    ).astype(jnp.float8_e4m3fn)
+    # 7168/128 = 56 scale groups per token, lane-padded to 128 (Mosaic
+    # DMA-slices need a 128-multiple minor dim); the padding's bytes ride
+    # the wire and are counted.
+    a2a_scales = jax.random.uniform(
+        jax.random.fold_in(key, 11), (a2a_world, 128, 128), jnp.float32)
+    a2a_counts = jnp.full((a2a_world,), 128, jnp.int32)
+    a2a_bytes = 2 * (toks.size + a2a_scales.size * 4
+                     + a2a_world * 8 * 128 * 4)  # r+w of every payload
+    a2a_floor_ms = a2a_bytes / _hbm_gbps() / 1e6
+
+    def body_a2a(acc, t, s):
+        ss = s + dep_scalar(acc)
+        (ot, osc), _rc = a2a_loopback((t, ss), a2a_counts, ctx=a2a_ctx,
+                                      world=a2a_world)
+        return acc + osc[:, :, 0]
+
+    (a2a_ms,) = _paired_slopes(
+        [_acc_loop(body_a2a, out_shape=(a2a_world, 128))], toks, a2a_scales,
+        0, ms_bounds=(0.9 * a2a_floor_ms, 50 * a2a_floor_ms))
+
+    # -- distributed flash-decode local arm --------------------------------
+    # Qwen3-32B decode shape (VERDICT r3 missing #1): B=128, Hq=64, Hkv=8,
+    # dh=128, 16k context — the split-KV Pallas kernel the engine and the
+    # SP decode layer route through. Decode attention is HBM-bound (reads
+    # the whole 8.6 GB KV cache once), so the roofline is bytes/bw and the
+    # sanity metric is the fraction of HBM peak it sustains.
+    from triton_distributed_tpu.kernels.sp_attention import flash_decode_local
+
+    # K and V ride as SEPARATE arrays: a stacked (2, ...) array sliced
+    # inside the loop materializes 8.6 GB of copies next to the cache and
+    # OOMs the 16 GB chip.
+    Bd, Hqd, Hkvd, dhd, Sd = 128, 64, 8, 128, 16384
+    qd = jax.random.normal(jax.random.fold_in(key, 12), (Bd, Hqd, dhd),
+                           jnp.bfloat16)
+    kd = jax.random.normal(jax.random.fold_in(key, 13),
+                           (Bd, Sd, Hkvd, dhd), jnp.bfloat16)
+    vd = jax.random.normal(jax.random.fold_in(key, 14),
+                           (Bd, Sd, Hkvd, dhd), jnp.bfloat16)
+    fd_bytes = (kd.size + vd.size) * 2  # the KV cache read dominates
+    fd_floor_ms = fd_bytes / _hbm_gbps() / 1e6
+
+    def body_fd(acc, q, kv):
+        qq = q + dep_scalar(acc).astype(q.dtype)
+        out, _lse = flash_decode_local(qq, kv[0], kv[1], kv_len=Sd,
+                                       kv_layout="bshd")
+        return acc + out.reshape(Bd, Hqd * dhd)
+
+    (fd_ms,) = _paired_slopes(
+        [_acc_loop(body_fd, out_shape=(Bd, Hqd * dhd))], qd, (kd, vd), 0,
+        rounds=8, ms_bounds=(0.95 * fd_floor_ms, 20 * fd_floor_ms))
+    del qd, kd, vd  # 8.6 GB back before the e2e engine allocates
 
     # -- extras ------------------------------------------------------------
     # GEMM-RS smoke shape (docs/build.md:96, per-rank K = 29568/8 = 3696 —
@@ -230,8 +416,29 @@ def _run_benchmarks():
         bb = b + dep_scalar(acc).astype(b.dtype)
         return acc + ag_gemm_single_chip(a, bb).astype(jnp.float32)
 
+    # Measured ragged-K story (VERDICT r3 missing #2 / next #6): the same
+    # shape through a PAD-AND-MASK Pallas path — K 3696 -> 3712 (the next
+    # 128 multiple, +0.4% FLOPs; zeros contribute nothing to the product).
+    # B is padded OUTSIDE the loop (weights pad once at load time in a real
+    # caller); A pads per call inside the timed body, as a real activation
+    # would. The faster arm is the documented bound for this shape.
+    KPAD = 3712
+    b2p = jnp.pad(b2, ((0, KPAD - 3696), (0, 0)))
+
+    def body_smoke_padded(acc, a, bp):
+        aa = a + dep_scalar(acc).astype(a.dtype)
+        ap = jnp.pad(aa, ((0, 0), (0, KPAD - 3696)))
+        # (512, 512, full-K): the largest block whose single-pass working
+        # set fits scoped VMEM at K=3712 without raising the Mosaic limit.
+        return acc + ag_gemm_single_chip(
+            ap, bp, block_m=512, block_n=512, block_k=KPAD
+        ).astype(jnp.float32)
+
     (rs_ms,) = _paired_slopes([_acc_loop(body_smoke)], a2, b2,
                               2 * 8192 * 3696 * 8192)
+    (rs_pad_ms,) = _paired_slopes(
+        [_acc_loop(body_smoke_padded, out_shape=(8192, 8192))], a2, b2p,
+        2 * 8192 * 3696 * 8192)
 
     # Flash prefill vs the dense-score attention at a long-context shape
     # (B=2, L=S=2048, 16q/8kv heads, dh=128): the Pallas streaming-softmax
@@ -300,6 +507,52 @@ def _run_benchmarks():
     (mlp_ms,) = _paired_slopes(
         [_acc_loop(body_mlp, out_shape=(4096, 5120))], am, bm, mlp_flops)
 
+    # -- small-M AllReduce-mode regime (VERDICT r3 missing #4) -------------
+    # The reference's loudest wins are M=128 GEMM + fused AllReduce
+    # (1.27-1.37x, e2e_dense.md:33-37). Per-chip honest pair at the same
+    # per-rank Qwen3-32B TP=8 shapes: ours = tuned Pallas GEMMs + GLU +
+    # the FULL one-shot-AR machinery via local DMA (oneshot_ar_loopback);
+    # twin = XLA GEMMs + GLU with comm free (world=1 psum is identity) —
+    # the twin pays no machinery, so ratio >= 1.0 means the Pallas GEMMs
+    # buy back more than the AR machinery costs.
+    from triton_distributed_tpu.kernels.allreduce import oneshot_ar_loopback
+
+    Msm = 128
+    sm_up = tuned_matmul_blocks(Msm, 5120, 6400)
+    sm_down = tuned_matmul_blocks(Msm, 3200, 5120)
+    xs = jax.random.normal(jax.random.fold_in(kmlp, 3), (Msm, 5120),
+                           jnp.bfloat16)
+    sm_flops = 2 * Msm * 5120 * 6400 + 2 * Msm * 3200 * 5120
+
+    def _glu(h):
+        ff = h.shape[-1] // 2
+        return (jax.nn.silu(h[:, :ff].astype(jnp.float32))
+                * h[:, ff:].astype(jnp.float32)).astype(h.dtype)
+
+    def _mm(x, w, blocks):
+        if blocks is None:  # no candidate divides: auto path
+            return ag_gemm_single_chip(x, w)
+        return ag_gemm_single_chip(x, w, block_m=blocks[0],
+                                   block_n=blocks[1], block_k=blocks[2])
+
+    def body_small_ar(acc, x, w_gate_up):
+        xx = x + dep_scalar(acc).astype(x.dtype)
+        h = _mm(xx, w_gate_up, sm_up)
+        partial = _mm(_glu(h), w_down, sm_down)
+        return acc + oneshot_ar_loopback(partial, world=8
+                                         ).astype(jnp.float32)
+
+    def body_small_xla(acc, x, w_gate_up):
+        xx = x + dep_scalar(acc).astype(x.dtype)
+        h = jnp.dot(xx, w_gate_up)
+        partial = jnp.dot(_glu(h), w_down)
+        return acc + partial.astype(jnp.float32)
+
+    sm_ar_ms, sm_xla_ms = _paired_slopes(
+        [_acc_loop(body_small_ar, out_shape=(Msm, 5120)),
+         _acc_loop(body_small_xla, out_shape=(Msm, 5120))], xs, bm,
+        sm_flops)
+
     # E2E engine decode: Qwen3-1.7B (4B params OOM'd the 16GB chip next to
     # the bench's other live arrays),
     # random weights, B=8, 128-token prompt — the WHOLE decode loop runs
@@ -312,6 +565,10 @@ def _run_benchmarks():
         e2e = _bench_e2e_decode()
     except Exception as e:  # noqa: BLE001 — bench must still print its line
         e2e = {"e2e_error": f"{type(e).__name__}: {str(e)[:120]}"}
+    try:
+        e2e.update(_bench_e2e_subprocess("qwen3-4b"))
+    except Exception as e:  # noqa: BLE001
+        e2e["qwen3_4b_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
     print(json.dumps({
         "metric": "ag_gemm_loopback_m4096_qwen32b_tp8_ms",
@@ -321,10 +578,32 @@ def _run_benchmarks():
         "extras": {
             "bare_consumer_matmul_ms": round(bare_ms, 4),
             "overlap_efficiency": round(bare_ms / loopback_ms, 4),
+            # Gap decomposition: grid-structure (B re-fetch per segment,
+            # inherent to segment-granularity consumption) vs staging
+            # machinery (extra HBM pass + semaphores), with the unhidden
+            # HBM bound for the staging bytes as the yardstick.
+            "ag_segmented_bare_ms": round(segbare_ms, 4),
+            "ag_grid_structure_ms": round(segbare_ms - bare_ms, 4),
+            "ag_staging_machinery_ms": round(loopback_ms - segbare_ms, 4),
+            "ag_staging_bound_ms": round(ag_staging_bound_ms, 4),
             "fused_step_pallas_ms": round(fused_ms, 4),
             "fused_step_xla_ms": round(xla_ms, 4),
             "pallas_over_xla": round(fused_ms / xla_ms, 4),
+            "gemm_rs_loopback_m4096_ms": round(rs_loop_ms, 4),
+            "gemm_rs_bare_matmul_ms": round(rs_bare_ms, 4),
+            "gemm_rs_overlap_efficiency": round(rs_bare_ms / rs_loop_ms, 4),
+            "gemm_rs_staging_bound_ms": round(rs_staging_bound_ms, 4),
+            "a2a_dispatch_loopback_us": round(a2a_ms * 1e3, 2),
+            "a2a_loopback_hbm_frac": round(a2a_floor_ms / a2a_ms, 4),
+            "flash_decode_b128_16k_ms": round(fd_ms, 4),
+            "flash_decode_hbm_frac": round(fd_floor_ms / fd_ms, 4),
             "gemm_rs_smoke_shape_ms_xla_delegated": round(rs_ms, 4),
+            "gemm_rs_smoke_shape_ms_padded_pallas": round(rs_pad_ms, 4),
+            "ragged_k_best": "padded_pallas" if rs_pad_ms < rs_ms else "xla",
+            "mlp_m128_ar_loopback_ms": round(sm_ar_ms, 4),
+            "mlp_m128_xla_free_comm_ms": round(sm_xla_ms, 4),
+            "mlp_m128_ar_ratio": round(sm_xla_ms / sm_ar_ms, 4),
+            "mlp_m128_vs_h800_baseline": round(0.0918 / sm_ar_ms, 4),
             "flash_prefill_b2_l2048_ms": round(flash_ms, 4),
             "dense_attn_same_shape_ms": round(dense_ms, 4),
             "flash_prefill_speedup": round(dense_ms / flash_ms, 4),
@@ -335,13 +614,13 @@ def _run_benchmarks():
     }))
 
 
-def _bench_e2e_decode():
+def _bench_e2e_decode(model_name: str = "qwen3-1.7b", with_aot: bool = True):
     import numpy as np
 
     from triton_distributed_tpu.models import Engine, ModelConfig
     from triton_distributed_tpu.runtime.mesh import make_mesh
 
-    config = ModelConfig.from_name("qwen3-1.7b", max_length=512)
+    config = ModelConfig.from_name(model_name, max_length=512)
     mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
                       set_default=False)
     engine = Engine(config, mesh=mesh1, mode="dist",
@@ -353,7 +632,9 @@ def _bench_e2e_decode():
     def run(gen):
         t0 = time.perf_counter()
         out = engine.serve_scanned(ids, gen)
-        jax.block_until_ready(out)
+        int(out[0, -1])  # host read: block_until_ready does NOT force
+        # completion on the tunneled backend (measured: hoisted loops
+        # "finished" in 0.1 ms); only a host read does.
         return (time.perf_counter() - t0) * 1e3
 
     run(g_short)
@@ -364,9 +645,84 @@ def _bench_e2e_decode():
     if not pos:
         return {"e2e_error": "no plausible decode slope"}
     ms_tok = float(np.median(pos))
+    tag = model_name.replace("qwen3-", "qwen3_").replace(".", "p")
+    out = {
+        f"{tag}_b8_decode_ms_per_token": round(ms_tok, 4),
+        f"{tag}_b8_decode_tokens_per_s": round(B * 1e3 / ms_tok, 1),
+    }
+    if with_aot:
+        try:
+            out.update(_bench_aot_coldstart(engine, B))
+        except Exception as e:  # noqa: BLE001
+            out["aot_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    return out
+
+
+def _bench_e2e_subprocess(model_name: str) -> dict:
+    """Run the e2e decode arm for ``model_name`` in a FRESH process and
+    merge its extras. qwen3-4b fits the 16 GB chip alone but not next to
+    the bench's other live arrays (VERDICT r3 next #3) — a subprocess gets
+    a clean HBM and releases it on exit."""
+    import subprocess
+    import sys
+
+    r = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--e2e-only", model_name],
+        capture_output=True, text=True, timeout=1200,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {f"{model_name}_error": (r.stderr or r.stdout)[-160:]}
+
+
+def _bench_aot_coldstart(engine, B):
+    """Cold-start cut from the serialized-executable cache (VERDICT r3 next
+    #7): build the decode-step executable twice — trace+XLA-compile vs
+    lower+deserialize from AOTExecutableCache — and report both. The
+    deserialize path still pays ``jit.lower()`` (the cache key hashes the
+    lowering, so a stale executable can never be served); the metric is the
+    honest end-to-end "process start to runnable step" time either way."""
+    import shutil
+    import tempfile
+
+    from triton_distributed_tpu.tools.aot import AOTExecutableCache
+
+    step = engine._step_fn("dist")
+    kv = engine.new_cache(B)
+    abstract = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+        (engine.params, jnp.ones((B, 1), jnp.int32), kv))
+    del kv
+
+    # A true cold compile: the persistent XLA cache (enabled in main) would
+    # otherwise serve a previous run's binary and undercut the baseline.
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        t0 = time.perf_counter()
+        step.lower(*abstract).compile()
+        compile_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        jax.config.update("jax_enable_compilation_cache", True)
+
+    tmp = tempfile.mkdtemp(prefix="tdt_aot_bench_")
+    try:
+        AOTExecutableCache(tmp).load_or_compile(
+            "bench_decode_step", step, *abstract, mesh=engine.mesh)
+        t0 = time.perf_counter()
+        _, source = AOTExecutableCache(tmp).load_or_compile(
+            "bench_decode_step", step, *abstract, mesh=engine.mesh)
+        deser_ms = (time.perf_counter() - t0) * 1e3
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    if source != "cache":
+        return {"aot_error": f"expected cache hit, got {source}"}
     return {
-        "qwen3_1p7b_b8_decode_ms_per_token": round(ms_tok, 4),
-        "qwen3_1p7b_b8_decode_tokens_per_s": round(B * 1e3 / ms_tok, 1),
+        "aot_step_trace_compile_ms": round(compile_ms, 1),
+        "aot_step_deserialize_ms": round(deser_ms, 1),
+        "aot_coldstart_speedup": round(compile_ms / deser_ms, 2),
     }
 
 

@@ -162,3 +162,17 @@ def test_ag_gemm_loopback(rng):
     got = jax.jit(lambda a, b: ag_gemm_loopback(
         a, b, segments=8, config=AGGEMMConfig(block_n=128)))(a, b)
     assert_allclose(got, np.asarray(a) @ np.asarray(b))
+
+
+def test_ag_gemm_segmented_bare(rng):
+    """The decomposition arm (loopback grid without staging) is a plain
+    matmul."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_segmented_bare,
+    )
+
+    M, K, N = 64, 32, 128
+    a, b = _ab(rng, M, K, N)
+    got = jax.jit(lambda a, b: ag_gemm_segmented_bare(
+        a, b, segments=8, config=AGGEMMConfig(block_n=128)))(a, b)
+    assert_allclose(got, np.asarray(a) @ np.asarray(b))

@@ -122,3 +122,15 @@ def test_all_reduce_auto_falls_back_on_non_divisible(mesh8, rng):
     x = _stacked(rng, (WORLD, 13, 128))
     out = all_reduce(x, mesh=mesh8, method="one_shot")
     assert_allclose(out, np.asarray(x).sum(axis=0))
+
+
+def test_oneshot_ar_loopback(rng):
+    """Self-loopback one-shot AR (staging pushes + arrival waits + fixed
+    fold on one device): every slot carries the own buffer -> world * x."""
+    import jax
+
+    from triton_distributed_tpu.kernels.allreduce import oneshot_ar_loopback
+
+    x = jnp.asarray(rng.standard_normal((16, 128), dtype=np.float32))
+    got = jax.jit(lambda x: oneshot_ar_loopback(x, world=8))(x)
+    assert_allclose(got, 8.0 * np.asarray(x), atol=1e-4, rtol=1e-5)

@@ -64,3 +64,24 @@ def test_assert_allclose_reports():
     b[1, 2] = 1.0
     with pytest.raises(AssertionError, match="worst at"):
         assert_allclose(a, b)
+
+
+def test_pod_check_virtual_mesh():
+    """The multi-host runbook's first command (docs/build-and-run.md step
+    0) must walk its whole bring-up ladder green on the virtual mesh."""
+    import os
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms', 'cpu');"
+         "from triton_distributed_tpu.tools import pod_check;"
+         "import sys; sys.exit(pod_check.main())"],
+        capture_output=True, text=True, timeout=600, env=env, cwd="/tmp")
+    assert r.returncode == 0, f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}"
+    assert "POD READY" in r.stdout

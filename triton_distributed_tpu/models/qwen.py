@@ -89,54 +89,68 @@ class Qwen3:
             params, self.param_specs())
 
     def init(self, key, mesh: Mesh | None = None):
-        """Random sharded params (tests / dryruns; real runs use load_hf)."""
+        """Random sharded params (tests / dryruns; real runs use load_hf).
+
+        Each layer-stacked leaf is generated with ONE vectorized random
+        call under a jit with sharded ``out_shardings``: the old per-layer
+        eager loop + ``jnp.stack`` held every per-layer weight AND the
+        stacked copy live at once (2x the 8 GB of qwen3-4b — the
+        standalone-bench OOM), while here XLA's buffer assignment frees
+        each fp32 transient as soon as its bf16 leaf is cast."""
         mesh = mesh or get_default_mesh()
         world = mesh.shape[self.axis]
         c = self.config
-        n_keys = 4 + 7 * c.n_layers
-        keys = iter(jax.random.split(key, n_keys))
+        d, dh, L = c.d_model, c.head_dim, c.n_layers
 
-        def norm(*shape):
-            return jnp.ones(shape, jnp.float32)
+        shardings = jax.tree.map(lambda s: NamedSharding(mesh, s),
+                                 self.param_specs())
 
-        def randw(k, din, dout):
-            return (jax.random.normal(k, (din, dout)) * din ** -0.5
-                    ).astype(c.dtype)
+        @functools.partial(jax.jit, out_shardings=shardings)
+        def make(key):
+            ks = iter(jax.random.split(key, 9))
 
-        layers = {"input_norm": [], "post_norm": [],
-                  "attn": {"w_qkv": [], "w_o": [], "q_norm": [], "k_norm": []},
-                  "mlp": {"w_gate_up": [], "w_down": []}}
-        d, dh = c.d_model, c.head_dim
-        for _ in range(c.n_layers):
-            wq = randw(next(keys), d, c.n_heads * dh)
-            wk = randw(next(keys), d, c.n_kv_heads * dh)
-            wv = randw(next(keys), d, c.n_kv_heads * dh)
-            wo = randw(next(keys), c.n_heads * dh, d)
-            wg = randw(next(keys), d, c.d_ff)
-            wu = randw(next(keys), d, c.d_ff)
-            wd = randw(next(keys), c.d_ff, d)
-            layers["input_norm"].append(norm(d))
-            layers["post_norm"].append(norm(d))
-            layers["attn"]["w_qkv"].append(self.attn.pack_qkv(wq, wk, wv, world))
-            layers["attn"]["w_o"].append(wo)
-            layers["attn"]["q_norm"].append(norm(dh))
-            layers["attn"]["k_norm"].append(norm(dh))
-            layers["mlp"]["w_gate_up"].append(
-                self.mlp.interleave_gate_up(wg, wu, world))
-            layers["mlp"]["w_down"].append(wd)
-        if not c.qk_norm:
-            layers["attn"].pop("q_norm")
-            layers["attn"].pop("k_norm")
-        params = {
-            "embed": (jax.random.normal(next(keys), (c.vocab_size, d))
-                      * d ** -0.5).astype(c.dtype),
-            "final_norm": norm(d),
-            "layers": jax.tree.map(lambda x: jnp.stack(x), layers,
-                                   is_leaf=lambda x: isinstance(x, list)),
-        }
-        if not c.tie_embeddings:
-            params["lm_head"] = randw(next(keys), d, c.vocab_size)
-        return self._place(params, mesh)
+            def norm(*shape):
+                return jnp.ones(shape, jnp.float32)
+
+            def randw(k, shape, fan_in):
+                return (jax.random.normal(k, shape) * fan_in ** -0.5
+                        ).astype(c.dtype)
+
+            wq = randw(next(ks), (L, d, c.n_heads * dh), d)
+            wk = randw(next(ks), (L, d, c.n_kv_heads * dh), d)
+            wv = randw(next(ks), (L, d, c.n_kv_heads * dh), d)
+            wg = randw(next(ks), (L, d, c.d_ff), d)
+            wu = randw(next(ks), (L, d, c.d_ff), d)
+            attn = {
+                "w_qkv": jax.vmap(
+                    lambda q, k_, v: self.attn.pack_qkv(q, k_, v, world)
+                )(wq, wk, wv),
+                "w_o": randw(next(ks), (L, c.n_heads * dh, d),
+                             c.n_heads * dh),
+            }
+            if c.qk_norm:
+                attn["q_norm"] = norm(L, dh)
+                attn["k_norm"] = norm(L, dh)
+            params = {
+                "embed": randw(next(ks), (c.vocab_size, d), d),
+                "final_norm": norm(d),
+                "layers": {
+                    "input_norm": norm(L, d),
+                    "post_norm": norm(L, d),
+                    "attn": attn,
+                    "mlp": {
+                        "w_gate_up": jax.vmap(
+                            lambda g, u: self.mlp.interleave_gate_up(
+                                g, u, world))(wg, wu),
+                        "w_down": randw(next(ks), (L, c.d_ff, d), c.d_ff),
+                    },
+                },
+            }
+            if not c.tie_embeddings:
+                params["lm_head"] = randw(next(ks), (d, c.vocab_size), d)
+            return params
+
+        return make(key)
 
     def load_hf(self, path: str, mesh: Mesh | None = None):
         """Load weights from a local HuggingFace Qwen3 checkpoint directory

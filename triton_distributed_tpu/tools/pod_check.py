@@ -1,0 +1,111 @@
+"""Pod bring-up smoke check — the "first test to run" of the multi-host
+runbook (docs/build-and-run.md; the role of the reference's
+``scripts/launch.sh`` + ``test_nvshmem_api.py`` first-run combo,
+launch.sh:137-171).
+
+Run on EVERY host of the job (see scripts/launch.sh):
+
+    bash scripts/launch.sh -m triton_distributed_tpu.tools.pod_check
+
+Performs, in order, printing one `[pod_check] ...` line per stage:
+  1. rendezvous      — initialize_distributed() (env/metadata driven)
+  2. topology        — chips, hosts, slices, device kind
+  3. mesh            — make_2d_mesh (dcn x ici) or flat tp mesh
+  4. xla collective  — psum over every axis, verified against host math
+  5. pallas kernel   — the ll_allgather overlap kernel over the ici axis
+     (device-initiated remote DMA + semaphores: proves the Mosaic path,
+     not just XLA's collectives)
+
+Exit code 0 = the pod is ready for the full framework. Any hang here is a
+rendezvous/topology problem, not a framework one — check
+JAX_COORDINATOR_ADDRESS / MEGASCALE_* per the runbook.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def log(msg: str) -> None:
+    print(f"[pod_check] p{jax.process_index()}: {msg}", flush=True)
+
+
+def main() -> int:
+    from triton_distributed_tpu.runtime.mesh import (
+        Topology,
+        initialize_distributed,
+        make_2d_mesh,
+        make_mesh,
+    )
+
+    initialize_distributed()
+    log(f"rendezvous ok: process {jax.process_index()}/{jax.process_count()}")
+
+    topo = Topology.detect()
+    log(f"topology: {topo.num_devices} x {topo.device_kind} on "
+        f"{topo.num_processes} host(s), {topo.num_slices} slice(s)")
+
+    if topo.multi_slice:
+        mesh = make_2d_mesh(topo)
+        axes = ("dcn", "ici")
+    else:
+        mesh = make_mesh({"tp": topo.num_devices})
+        axes = ("tp",)
+    log(f"mesh: {dict(mesh.shape)}")
+
+    # XLA collective sanity: psum of each device's global rank over every
+    # axis must equal the arithmetic series sum.
+    x = jnp.arange(topo.num_devices, dtype=jnp.float32)
+
+    def psum_all(v):
+        out = v
+        for ax in axes:
+            out = jax.lax.psum(out, ax)
+        return out
+
+    total = jax.jit(jax.shard_map(psum_all, mesh=mesh,
+                                  in_specs=P(axes if len(axes) > 1 else axes[0]),
+                                  out_specs=P(axes if len(axes) > 1 else axes[0]),
+                                  check_vma=False))(x)
+    expect = float(x.sum())
+    # Read only this host's shard: a global fetch of a multi-host array
+    # raises "spans non-addressable devices" — exactly the deployment this
+    # tool exists for. Every shard holds the same psum value.
+    got = float(total.addressable_shards[0].data.ravel()[0])
+    if abs(got - expect) > 1e-3:
+        log(f"FAIL: psum got {got}, want {expect}")
+        return 1
+    log(f"xla psum over {axes} ok ({got:g})")
+
+    # Device-initiated Pallas path: the allgather overlap kernel (remote
+    # DMA + per-segment semaphores) over the ICI axis — AUTO picks the
+    # hierarchical 2D method by itself on a multi-slice mesh.
+    from triton_distributed_tpu.kernels.allgather import all_gather
+
+    ici = axes[-1]
+    world = topo.num_devices
+    rows = jnp.arange(world * 8 * 128, dtype=jnp.float32
+                      ).reshape(world, 8, 128)
+    gathered = all_gather(rows, mesh=mesh, axis=ici,
+                          dcn_axis=axes[0] if topo.multi_slice else None)
+    # The gathered result is replicated: every host's addressable shard
+    # holds the full (world*8, 128) array — compare locally, never fetch
+    # across hosts.
+    local = jnp.asarray(gathered.addressable_shards[0].data)
+    ok = (local.shape == (world * 8, 128) and bool(
+        jnp.allclose(local, jnp.arange(world * 8 * 128, dtype=jnp.float32
+                                       ).reshape(world * 8, 128))))
+    if not ok:
+        log("FAIL: pallas allgather mismatch")
+        return 1
+    log(f"pallas allgather over '{ici}' ok")
+    log("POD READY")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
