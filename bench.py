@@ -77,21 +77,19 @@ def _peak_tflops() -> float:
 
 def _hbm_gbps() -> float:
     """Per-chip HBM bandwidth (GB/s) for the roofline bounds of the
-    DMA/HBM-bound arms (a2a latency, flash decode). Same spirit as
-    ``_peak_tflops``: public speeds-and-feeds per device kind."""
-    kind = jax.devices()[0].device_kind.lower()
-    rates = {"v5 lite": 819.0, "v5lite": 819.0, "v5e": 819.0,
-             "v4": 1228.0, "v5p": 2765.0, "v5": 2765.0,
-             "v6 lite": 1640.0, "v6e": 1640.0}
-    for tag, r in rates.items():
-        if tag in kind:
-            return r
-    return 3500.0
+    DMA/HBM-bound arms (a2a latency, flash decode) — single source of
+    truth is the runtime perf model's speeds-and-feeds table (which also
+    feeds the autotuner's plausibility gate; two drifting tables once
+    disagreed 4x on the unknown-device fallback)."""
+    from triton_distributed_tpu.runtime.perf_model import detect_hardware
+
+    return detect_hardware().hbm_bw / 1e9
 
 
 PEAK_TFLOPS = None  # resolved lazily in main (needs a live backend)
 BASE_AG_GEMM_MS = 1.8002   # 8x MI308X AG_GEMM M=4096 (e2e_dense.md:43)
 BASE_MLP_MS = 0.885        # 8x H800 MLP M=4096 (e2e_dense.md:19-25)
+BASE_MLP_M128_MS = 0.0918  # 8x H800 MLP M=128 AR mode (e2e_dense.md:33)
 
 M, K, N = 4096, 5120, 3200
 FLOPS = 2 * M * K * N
@@ -603,7 +601,8 @@ def _run_benchmarks():
             "mlp_m128_ar_loopback_ms": round(sm_ar_ms, 4),
             "mlp_m128_xla_free_comm_ms": round(sm_xla_ms, 4),
             "mlp_m128_ar_ratio": round(sm_xla_ms / sm_ar_ms, 4),
-            "mlp_m128_vs_h800_baseline": round(0.0918 / sm_ar_ms, 4),
+            "mlp_m128_vs_h800_baseline": round(BASE_MLP_M128_MS / sm_ar_ms,
+                                               4),
             "flash_prefill_b2_l2048_ms": round(flash_ms, 4),
             "dense_attn_same_shape_ms": round(dense_ms, 4),
             "flash_prefill_speedup": round(dense_ms / flash_ms, 4),

@@ -286,7 +286,9 @@ def test_a2a_loopback(rng):
     ctx = AllToAllContext(capacity=cap, hidden=hidden, axis="tp")
     toks_f32 = rng.standard_normal((world, cap, hidden), dtype=np.float32)
     toks = jnp.asarray(toks_f32.astype(ml_dtypes.float8_e4m3fn))
-    scales = jnp.asarray(rng.random((world, cap, 1), dtype=np.float32))
+    # 128-wide scales: lane-aligned, so the same test runs compiled on a
+    # real TPU (the alignment validator rejects sub-lane minor dims there).
+    scales = jnp.asarray(rng.random((world, cap, 128), dtype=np.float32))
     counts = jnp.asarray(rng.integers(0, cap + 1, world), jnp.int32)
 
     (otoks, oscales), rcounts = jax.jit(

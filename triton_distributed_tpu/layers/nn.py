@@ -156,7 +156,14 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
 
     p = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("blhgs,bshd->blhgd", p.astype(v_cache.dtype), v_cache,
+    # Fast path (use_flash_decode=True fell back here): probabilities ride
+    # in the cache's wire dtype so XLA streams V without an fp32 copy.
+    # GOLDEN mode (use_flash_decode=False — what the kernels are validated
+    # against, tp_attn.py xla_fwd) keeps full fp32 probabilities: the
+    # reference math must not carry a quantization the kernels don't.
+    if use_flash_decode:
+        p = p.astype(v_cache.dtype)
+    out = jnp.einsum("blhgs,bshd->blhgd", p, v_cache,
                      preferred_element_type=jnp.float32)
     return out.reshape(B, L, Hq, dh).astype(q.dtype)
 
