@@ -515,8 +515,16 @@ def _kv_chunk(m_kv: int, preferred: int = 512) -> int:
     return m_kv
 
 
+# KV staging budget for the decode kernel's double-buffered all-heads K+V
+# blocks — larger than the generic collective staging budget on purpose:
+# at B=128/Hkv=8/dh=128/16k the 1024-row chunk (8 MB staged) measured
+# ~17% faster than the 512-row one (fewer grid steps to amortize
+# per-step overhead against), and the kernel's other VMEM use is tiny.
+_DECODE_KV_BUDGET = 8 * 2 ** 20
+
+
 def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
-                       scale: float | None = None, chunk: int = 512,
+                       scale: float | None = None, chunk: int = 1024,
                        kv_layout: str = "bhsd", interpret=None):
     """Single-device split-KV GQA decode partial via the Pallas kernel.
 
@@ -543,7 +551,7 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
     # Chunk preference bounded so the double-buffered all-heads K+V blocks
     # stay under the staging budget.
     per_pos = Hkv * dh * k_cache.dtype.itemsize * 4
-    ck = _kv_chunk(m_kv, min(chunk, max(8, common.VMEM_STAGE_BUDGET // per_pos)))
+    ck = _kv_chunk(m_kv, min(chunk, max(8, _DECODE_KV_BUDGET // per_pos)))
     n_chunks = m_kv // ck
     kv_len = jnp.asarray(
         m_kv if kv_len is None else kv_len, jnp.int32).reshape(1)

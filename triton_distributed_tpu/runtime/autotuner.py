@@ -86,7 +86,7 @@ def perf_thunk(thunk: Callable[[], Any], *, iters: tuple[int, int] = (8, 24),
         t0 = time.perf_counter()
         for _ in range(n):
             out = thunk()
-        jax.block_until_ready(out)
+        _force_completion(out)
         return (time.perf_counter() - t0) * 1e3
 
     short, long_ = iters
@@ -162,10 +162,10 @@ class ContextualAutotuner:
         self.multi_timer = multi_timer
 
     # Bumped whenever the timing methodology changes: cached winners are
-    # only comparable within one methodology (r4: interleaved round-robin +
-    # lower quartile replaced sequential medians; old entries must not
+    # only comparable within one methodology (ilq2 = interleaved round-robin
+    # + plausibility gate + cohort-normalized medians; old entries must not
     # survive the switch — they were ranked under uncancelled drift).
-    _METHODOLOGY = "ilq1"
+    _METHODOLOGY = "ilq2"
 
     def _key(self, context_key: str) -> str:
         # The cached value is an INDEX into self.configs: the key must pin
@@ -461,17 +461,27 @@ def interleaved_slope_timer(loops, *, rounds: int = 13, ms_bounds=None):
     # median cancels it from the RANKING entirely; the median of a
     # candidate's normalized ratios across rounds is then far lower
     # variance than any absolute-time estimate. Scaled back to ms by the
-    # grand cohort median so callers still see real-unit times.
+    # grand cohort median so callers still see real-unit times. Only
+    # rounds where >=2 candidates survived the gate carry ranking signal
+    # (a singleton round pins its lone survivor's ratio to exactly 1.0 —
+    # uninformative, and it dilutes real differences); candidates seen
+    # only in singleton rounds fall back to their absolute median.
+    ranked = [rd for rd in per_round if len(rd) >= 2] or per_round
     grand = statistics.median(
-        v for rd in per_round for v in rd.values()) if per_round else None
+        v for rd in ranked for v in rd.values()) if ranked else None
     out: list[float] = []
     for i in range(len(loops)):
         if i in dead:
             out.append(float("inf"))
             continue
         ratios = [v / statistics.median(rd.values())
-                  for rd in per_round if (v := rd.get(i)) is not None]
-        out.append(statistics.median(ratios) * grand if ratios
+                  for rd in ranked if (v := rd.get(i)) is not None]
+        if ratios:
+            out.append(statistics.median(ratios) * grand)
+            continue
+        absolute = [v for rd in per_round
+                    if (v := rd.get(i)) is not None]
+        out.append(statistics.median(absolute) if absolute
                    else float("inf"))
     return out
 
@@ -507,7 +517,12 @@ def _tune_matmul_blocks(name: str, candidates, body_of, m: int, k: int,
         flops = 2.0 * m * k * n
         peak = _pm.detect_hardware().peak_bf16_flops * 1.02
         ms_lo = flops / peak * 1e3
-        bounds = (ms_lo, 20 * ms_lo)
+        # The FLOOR is dtype-independent physics (nothing beats the bf16
+        # peak); the CEILING must account for wider dtypes running the MXU
+        # multi-pass (f32 ~6x slower than bf16) or honest slow samples
+        # would gate out as "bursts" and the tune would never commit.
+        derate = {4: 6, 8: 13}.get(jnp.dtype(dtype_str).itemsize, 1)
+        bounds = (ms_lo, 20 * ms_lo * derate)
     tuner = ContextualAutotuner(
         name, list(candidates),
         multi_timer=functools.partial(interleaved_slope_timer,
