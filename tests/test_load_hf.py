@@ -113,3 +113,46 @@ def test_load_hf_roundtrip_packing(mesh8, hf_checkpoint):
     ref_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), ref)
     got_shapes = jax.tree.map(lambda x: (x.shape, str(x.dtype)), loaded)
     assert ref_shapes == got_shapes
+
+
+def test_load_hf_qwen3_moe_logits_match_transformers(mesh8,
+                                                     tmp_path_factory):
+    """The MoE family's HF layout (mlp.gate router + mlp.experts.{e}.*)
+    through load_hf: prefill logits vs a tiny transformers
+    Qwen3MoeForCausalLM — verifying router transpose, expert stacking and
+    the norm_topk_prob routing math against HF's implementation (the
+    reference's EP-MoE inference counterpart, test_ep_moe_inference.py)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    if not hasattr(transformers, "Qwen3MoeForCausalLM"):
+        pytest.skip("transformers too old for Qwen3Moe")
+
+    cfg = transformers.Qwen3MoeConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        moe_intermediate_size=32, num_experts=8, num_experts_per_tok=2,
+        norm_topk_prob=True, decoder_sparse_step=1, mlp_only_layers=[],
+        num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=8,
+        head_dim=8, max_position_embeddings=64, rope_theta=1e4,
+        rms_norm_eps=1e-6, tie_word_embeddings=False, attention_bias=False,
+        torch_dtype="float32",
+    )
+    torch.manual_seed(2)
+    model = transformers.Qwen3MoeForCausalLM(cfg)
+    model.eval()
+    path = tmp_path_factory.mktemp("qwen3_moe_tiny_hf")
+    model.save_pretrained(path, safe_serialization=True)
+
+    ids = np.random.default_rng(2).integers(0, 128, (B, L))
+    with torch.no_grad():
+        golden = model(torch.from_numpy(ids)).logits[:, -1].numpy()
+
+    config = ModelConfig.from_name(
+        "tiny-moe", vocab_size=128, d_model=64, n_layers=2, n_heads=8,
+        n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,
+        n_experts=8, n_experts_per_tok=2, moe_d_ff=32,
+        tie_embeddings=False, qk_norm=True, dtype=jnp.float32)
+    eng = Engine(config, mesh=mesh8, mode="xla", hf_path=str(path),
+                 block_n=8)
+    logits, _ = eng.prefill(jnp.asarray(ids, jnp.int32), eng.new_cache(B))
+    assert_allclose(logits, golden, atol=2e-3, rtol=2e-3,
+                    msg="qwen3-moe load_hf logits vs transformers")

@@ -5,4 +5,5 @@ from triton_distributed_tpu.layers.tp_mlp import TPMLP  # noqa: F401
 from triton_distributed_tpu.layers.tp_attn import TPAttn  # noqa: F401
 from triton_distributed_tpu.layers.sp_flash_decode_layer import SpGQAFlashDecodeAttention  # noqa: F401
 from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer  # noqa: F401
+from triton_distributed_tpu.layers.moe_mlp import MoEMLP  # noqa: F401
 from triton_distributed_tpu.layers.allgather_layer import AllGatherLayer  # noqa: F401

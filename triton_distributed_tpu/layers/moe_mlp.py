@@ -1,0 +1,207 @@
+"""Mixture-of-Experts MLP layer (Qwen3-MoE / DeepSeek-style sparse FFN).
+
+The model-facing MoE block the reference exercises end-to-end in
+``test/nvidia/test_ep_moe_inference.py`` (routing -> ``fast_all_to_all``
+dispatch -> grouped expert GEMMs -> combine) built from this repo's EP
+pieces: ``layers/ep_a2a_layer.EPAll2AllLayer`` (single-kernel a2a exchange)
+and ``kernels/moe_utils`` (capacity routing, grouped GEMM, topk combine).
+
+Router math follows HF ``Qwen3MoeSparseMoeBlock``: softmax over ALL expert
+logits in fp32, top-k, optional re-normalization of the selected
+probabilities (``norm_topk_prob``), weighted sum of gated-SwiGLU expert
+outputs.
+
+Sharding (inference EP-on-the-TP-axis, the reference's EP group):
+  router   (d, E)          replicated
+  w_gate_up (E, d, 2*ff_e) sharded on E over ``axis`` -> (E_local, d, 2ff)
+  w_down    (E, ff_e, d)   sharded on E over ``axis``
+  tokens   batch(M)-sharded like TPMLP.dist_fwd; the a2a moves each
+  (token, k) pair to its expert's owner and back.
+
+Static capacities (XLA-friendly): dispatch/expert grids are fixed-size;
+(token, k) pairs beyond capacity are DROPPED with the loss surfaced in the
+returned stats (the reference instead grows symmetric buffers — SURVEY
+§2.4 ep_a2a_layer.py:116-130). Defaults size capacities at
+``capacity_factor`` x the uniform-routing expectation; pass explicit
+capacities for drop-free runs (tests do).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.layers.ep_a2a_layer import EPAll2AllLayer
+from triton_distributed_tpu.runtime.mesh import get_default_mesh
+
+
+def _round8(x: int) -> int:
+    return max(8, (int(x) + 7) // 8 * 8)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEMLP:
+    """Sparse gated-SwiGLU FFN with top-k routing."""
+
+    d_model: int
+    d_ff: int                  # PER-EXPERT intermediate size
+    n_experts: int
+    topk: int
+    norm_topk_prob: bool = True
+    axis: str = "tp"
+    dtype: jnp.dtype = jnp.bfloat16
+    capacity_factor: float = 2.0
+    # Explicit capacity overrides (tokens per (src, dst) rank pair / per
+    # local expert); None = capacity_factor x uniform expectation.
+    capacity: int | None = None
+    expert_capacity: int | None = None
+
+    # -- parameters ---------------------------------------------------------
+
+    def init(self, key, mesh: Mesh | None = None):
+        mesh = mesh or get_default_mesh()
+        kr, kg, ku, kd = jax.random.split(key, 4)
+        d, ff, E = self.d_model, self.d_ff, self.n_experts
+        scale = d ** -0.5
+        params = {
+            "router": (jax.random.normal(kr, (d, E)) * scale
+                       ).astype(jnp.float32),
+            "w_gate_up": jnp.concatenate(
+                [(jax.random.normal(kg, (E, d, ff)) * scale).astype(self.dtype),
+                 (jax.random.normal(ku, (E, d, ff)) * scale).astype(self.dtype)],
+                axis=-1),
+            "w_down": (jax.random.normal(kd, (E, ff, d))
+                       * ff ** -0.5).astype(self.dtype),
+        }
+        return jax.tree.map(
+            lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+            params, self.param_specs())
+
+    def param_specs(self):
+        return {"router": P(),
+                "w_gate_up": P(self.axis, None, None),
+                "w_down": P(self.axis, None, None)}
+
+    @staticmethod
+    def stack_experts(gates, ups, downs):
+        """Pack per-expert (d, ff)/(ff, d) matrices (HF checkpoint layout)
+        into the stacked (E, d, 2ff)/(E, ff, d) leaves."""
+        return (jnp.concatenate([jnp.stack(gates), jnp.stack(ups)], axis=-1),
+                jnp.stack(downs))
+
+    # -- routing ------------------------------------------------------------
+
+    def route(self, router, x):
+        """HF Qwen3MoeSparseMoeBlock routing: fp32 softmax over all expert
+        logits -> top-k -> optional renormalization of the selected
+        probabilities. x: (n, d) -> (topk_weights (n, k) f32, ids (n, k))."""
+        logits = x.astype(jnp.float32) @ router.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        w, ids = jax.lax.top_k(probs, self.topk)
+        if self.norm_topk_prob:
+            w = w / jnp.sum(w, axis=-1, keepdims=True)
+        return w, ids.astype(jnp.int32)
+
+    def _expert_ffn(self, grouped, w_gate_up, w_down):
+        """Gated SwiGLU over a (E_local, cap, d) capacity grid (empty slots
+        are zero and stay zero through the gate)."""
+        h = moe_utils.grouped_gemm(grouped, w_gate_up)
+        ff = h.shape[-1] // 2
+        act = (jax.nn.silu(h[..., :ff].astype(jnp.float32))
+               * h[..., ff:].astype(jnp.float32)).astype(h.dtype)
+        return moe_utils.grouped_gemm(act, w_down)
+
+    def _ep_layer(self, n_local_tokens: int, world: int) -> EPAll2AllLayer:
+        pairs = n_local_tokens * self.topk
+        cap = self.capacity or min(
+            _round8(pairs * self.capacity_factor / world), _round8(pairs))
+        ecap = self.expert_capacity or min(
+            _round8(world * pairs * self.capacity_factor / self.n_experts),
+            _round8(world * cap))
+        return EPAll2AllLayer(
+            n_experts=self.n_experts, topk=self.topk, hidden=self.d_model,
+            capacity=cap, expert_capacity=ecap, axis=self.axis)
+
+    # -- per-device forwards (inside shard_map) -----------------------------
+
+    def dist_fwd(self, params, x_local, *, return_stats: bool = False,
+                 interpret=None):
+        """x_local: (n_local, d) M-shard -> (n_local, d) M-shard. Routing is
+        local (replicated router); the (token, k) pairs ride the
+        single-kernel a2a to their experts' owners and back.
+
+        ``return_stats=True`` additionally returns the dispatch drop
+        counters (``n_dropped_dispatch`` / ``n_dropped_expert`` int32
+        scalars) — THE observable for capacity sizing: the default
+        ``capacity_factor`` trades buffer memory for a chance of drops
+        under skewed routing, and serving stacks should audit these
+        counters at their traffic (then raise the factor or set explicit
+        capacities). The plain return keeps the dense-FFN contract for the
+        model body."""
+        world = jax.lax.axis_size(self.axis)
+        w, ids = self.route(params["router"], x_local)
+        ep = self._ep_layer(x_local.shape[0], world)
+        grouped, _, state = ep.dispatch(x_local, ids, w, interpret=interpret)
+        out = self._expert_ffn(grouped, params["w_gate_up"],
+                               params["w_down"])
+        y = ep.combine(out, state, interpret=interpret).astype(x_local.dtype)
+        if return_stats:
+            return y, state["stats"]
+        return y
+
+    def xla_fwd(self, params, x_local):
+        """Golden/baseline path: same math via jnp + XLA collectives —
+        every device computes the FULL expert set over the gathered batch
+        at worst-case capacity (zero drops), then keeps its M-shard."""
+        world = jax.lax.axis_size(self.axis)
+        x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
+        n = x_full.shape[0]
+        w, ids = self.route(params["router"], x_full)
+        # Worst-case capacity: all n*topk pairs on one expert -> no drops.
+        grid, slot, kept, _ = moe_utils.route_to_experts(
+            x_full, ids, n_experts=self.n_experts,
+            capacity=_round8(n * self.topk))
+        w_gate_up = jax.lax.all_gather(params["w_gate_up"], self.axis,
+                                       axis=0, tiled=True)
+        w_down = jax.lax.all_gather(params["w_down"], self.axis, axis=0,
+                                    tiled=True)
+        out_grid = self._expert_ffn(grid, w_gate_up, w_down)
+        out = moe_utils.combine_from_experts(out_grid, ids, w, slot, kept)
+        me = jax.lax.axis_index(self.axis)
+        m = n // world
+        return jax.lax.dynamic_slice_in_dim(
+            out, me * m, m, axis=0).astype(x_local.dtype)
+
+    # -- host-level ---------------------------------------------------------
+
+    def fwd(self, params, x, *, mesh: Mesh | None = None, mode: str = "dist",
+            interpret=None):
+        """x: global (M, d_model) sharded on M. Returns same layout."""
+        mesh = mesh or get_default_mesh()
+        return _build_fwd(self, mesh, mode, interpret)(params, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_fwd(layer: MoEMLP, mesh: Mesh, mode: str, interpret):
+    axis = layer.axis
+
+    def f(params, xl):
+        if mode == "dist":
+            return layer.dist_fwd(params, xl, interpret=interpret)
+        if mode == "xla":
+            return layer.xla_fwd(params, xl)
+        raise ValueError(f"unknown mode {mode!r}")
+
+    return jax.jit(
+        jax.shard_map(
+            f, mesh=mesh,
+            in_specs=(layer.param_specs(), P(axis, None)),
+            out_specs=P(axis, None),
+            check_vma=False,
+        )
+    )

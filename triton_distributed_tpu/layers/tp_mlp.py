@@ -77,6 +77,12 @@ class TPMLP:
         return (w[:, :, 0].reshape(self.d_model, self.d_ff),
                 w[:, :, 1].reshape(self.d_model, self.d_ff))
 
+    def param_specs(self):
+        """Per-layer sharding specs (the shared FFN-block contract with
+        MoEMLP — models stack these with a leading layer dim)."""
+        return {"w_gate_up": P(None, self.axis),
+                "w_down": P(self.axis, None)}
+
     def init(self, key, mesh: Mesh | None = None):
         """Sharded random params (models load real weights instead)."""
         mesh = mesh or get_default_mesh()

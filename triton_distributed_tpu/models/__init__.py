@@ -11,5 +11,9 @@ from triton_distributed_tpu.models.qwen import Qwen3  # noqa: F401
 # identical minus q_norm/k_norm (verified vs transformers logits,
 # tests/test_load_hf.py).
 Llama3 = Qwen3
+# The MoE family (Qwen3-30B-A3B / 235B-A22B presets) rides the SAME class:
+# config.n_experts > 0 swaps the FFN block for layers/moe_mlp.MoEMLP
+# (router + EP a2a dispatch + grouped expert GEMMs + combine).
+Qwen3Moe = Qwen3
 from triton_distributed_tpu.models.engine import Engine  # noqa: F401
 from triton_distributed_tpu.models.sampling import sample_token  # noqa: F401

@@ -34,6 +34,14 @@ class ModelConfig:
     qk_norm: bool = True
     max_length: int = 4096
     dtype: jnp.dtype = jnp.bfloat16
+    # Mixture-of-Experts (Qwen3-MoE family): n_experts == 0 means dense.
+    n_experts: int = 0
+    n_experts_per_tok: int = 8
+    moe_d_ff: int | None = None       # per-expert intermediate size
+    norm_topk_prob: bool = True
+    # EP buffer headroom over the uniform-routing expectation; raise for
+    # drop-free serving of skewed routings (layers/moe_mlp.py capacities).
+    moe_capacity_factor: float = 2.0
 
     @classmethod
     def from_name(cls, name: str, **overrides) -> "ModelConfig":
@@ -79,8 +87,22 @@ _PRESETS: dict[str, dict] = {
                          rope_theta=5e5, qk_norm=False,
                          rope_scaling=(32.0, 1.0, 4.0, 8192),
                          tie_embeddings=True, max_length=16_384),
+    # Qwen3-MoE family (HF config.json values: num_experts 128, top_k 8,
+    # norm_topk_prob, per-expert moe_intermediate_size).
+    "qwen3-30b-a3b": dict(d_model=2048, n_layers=48, n_heads=32,
+                          n_kv_heads=4, head_dim=128, d_ff=6144,
+                          n_experts=128, n_experts_per_tok=8,
+                          moe_d_ff=768),
+    "qwen3-235b-a22b": dict(d_model=4096, n_layers=94, n_heads=64,
+                            n_kv_heads=4, head_dim=128, d_ff=12_288,
+                            n_experts=128, n_experts_per_tok=8,
+                            moe_d_ff=1536),
     # Tiny config for tests / virtual-mesh dryruns (not a real checkpoint).
     "tiny": dict(vocab_size=128, d_model=64, n_layers=2, n_heads=8,
                  n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,
                  max_length=32, dtype=jnp.float32),
+    "tiny-moe": dict(vocab_size=128, d_model=64, n_layers=2, n_heads=8,
+                     n_kv_heads=8, head_dim=8, d_ff=128, rope_theta=1e4,
+                     max_length=32, dtype=jnp.float32, n_experts=8,
+                     n_experts_per_tok=2, moe_d_ff=32),
 }

@@ -55,8 +55,20 @@ class Qwen3:
                       rms_eps=c.rms_eps, block_n=self.block_n)
 
     @functools.cached_property
-    def mlp(self) -> TPMLP:
+    def mlp(self):
+        """The FFN block: dense TP (TPMLP) or sparse MoE (MoEMLP) — both
+        expose the same ``{dist,xla}_fwd(params, (n, d)) -> (n, d)``
+        per-device contract, so the decoder body is family-agnostic (the
+        reference's EP-MoE inference path, test_ep_moe_inference.py)."""
         c = self.config
+        if c.n_experts:
+            from triton_distributed_tpu.layers.moe_mlp import MoEMLP
+
+            return MoEMLP(d_model=c.d_model, d_ff=c.moe_d_ff,
+                          n_experts=c.n_experts, topk=c.n_experts_per_tok,
+                          norm_topk_prob=c.norm_topk_prob, axis=self.axis,
+                          dtype=c.dtype,
+                          capacity_factor=c.moe_capacity_factor)
         return TPMLP(d_model=c.d_model, d_ff=c.d_ff, axis=self.axis,
                      dtype=c.dtype, block_n=self.block_n)
 
@@ -75,8 +87,8 @@ class Qwen3:
                 "input_norm": P(),
                 "post_norm": P(),
                 "attn": attn,
-                "mlp": {"w_gate_up": P(None, None, a),
-                        "w_down": P(None, a, None)},
+                "mlp": jax.tree.map(lambda sp: P(None, *sp),
+                                    self.mlp.param_specs()),
             },
         }
         if not c.tie_embeddings:
@@ -119,8 +131,24 @@ class Qwen3:
             wq = randw(next(ks), (L, d, c.n_heads * dh), d)
             wk = randw(next(ks), (L, d, c.n_kv_heads * dh), d)
             wv = randw(next(ks), (L, d, c.n_kv_heads * dh), d)
-            wg = randw(next(ks), (L, d, c.d_ff), d)
-            wu = randw(next(ks), (L, d, c.d_ff), d)
+
+            def mlp_leaves():
+                if c.n_experts:
+                    E, ffe = c.n_experts, c.moe_d_ff
+                    return {
+                        "router": (jax.random.normal(next(ks), (L, d, E))
+                                   * d ** -0.5).astype(jnp.float32),
+                        "w_gate_up": randw(next(ks), (L, E, d, 2 * ffe), d),
+                        "w_down": randw(next(ks), (L, E, ffe, d), ffe),
+                    }
+                wg = randw(next(ks), (L, d, c.d_ff), d)
+                wu = randw(next(ks), (L, d, c.d_ff), d)
+                return {
+                    "w_gate_up": jax.vmap(
+                        lambda g, u: self.mlp.interleave_gate_up(
+                            g, u, world))(wg, wu),
+                    "w_down": randw(next(ks), (L, c.d_ff, d), c.d_ff),
+                }
             attn = {
                 "w_qkv": jax.vmap(
                     lambda q, k_, v: self.attn.pack_qkv(q, k_, v, world)
@@ -138,12 +166,7 @@ class Qwen3:
                     "input_norm": norm(L, d),
                     "post_norm": norm(L, d),
                     "attn": attn,
-                    "mlp": {
-                        "w_gate_up": jax.vmap(
-                            lambda g, u: self.mlp.interleave_gate_up(
-                                g, u, world))(wg, wu),
-                        "w_down": randw(next(ks), (L, c.d_ff, d), c.d_ff),
-                    },
+                    "mlp": mlp_leaves(),
                 },
             }
             if not c.tie_embeddings:
@@ -187,9 +210,12 @@ class Qwen3:
         def vec(name):
             return jnp.asarray(raw[name]).astype(jnp.float32)
 
+        moe = bool(c.n_experts)
+        mlp_init = ({"router": [], "w_gate_up": [], "w_down": []} if moe
+                    else {"w_gate_up": [], "w_down": []})
         layers = {"input_norm": [], "post_norm": [],
                   "attn": {"w_qkv": [], "w_o": [], "q_norm": [], "k_norm": []},
-                  "mlp": {"w_gate_up": [], "w_down": []}}
+                  "mlp": mlp_init}
         for i in range(c.n_layers):
             p = f"model.layers.{i}."
             layers["input_norm"].append(vec(p + "input_layernorm.weight"))
@@ -202,10 +228,26 @@ class Qwen3:
             if c.qk_norm:
                 layers["attn"]["q_norm"].append(vec(p + "self_attn.q_norm.weight"))
                 layers["attn"]["k_norm"].append(vec(p + "self_attn.k_norm.weight"))
-            layers["mlp"]["w_gate_up"].append(self.mlp.interleave_gate_up(
-                t(p + "mlp.gate_proj.weight"),
-                t(p + "mlp.up_proj.weight"), world))
-            layers["mlp"]["w_down"].append(t(p + "mlp.down_proj.weight"))
+            if moe:
+                # HF Qwen3-MoE: mlp.gate = router (E, d) stored (out, in);
+                # per-expert gate/up/down under mlp.experts.{e}.
+                layers["mlp"]["router"].append(
+                    jnp.asarray(raw[p + "mlp.gate.weight"]).T.astype(
+                        jnp.float32))
+                gu, dn = self.mlp.stack_experts(
+                    [t(p + f"mlp.experts.{e}.gate_proj.weight")
+                     for e in range(c.n_experts)],
+                    [t(p + f"mlp.experts.{e}.up_proj.weight")
+                     for e in range(c.n_experts)],
+                    [t(p + f"mlp.experts.{e}.down_proj.weight")
+                     for e in range(c.n_experts)])
+                layers["mlp"]["w_gate_up"].append(gu)
+                layers["mlp"]["w_down"].append(dn)
+            else:
+                layers["mlp"]["w_gate_up"].append(self.mlp.interleave_gate_up(
+                    t(p + "mlp.gate_proj.weight"),
+                    t(p + "mlp.up_proj.weight"), world))
+                layers["mlp"]["w_down"].append(t(p + "mlp.down_proj.weight"))
         if not c.qk_norm:
             layers["attn"].pop("q_norm")
             layers["attn"].pop("k_norm")
@@ -245,6 +287,11 @@ class Qwen3:
         else:
             raise ValueError(f"unknown mode {mode!r}")
 
+        if mode == "ar" and c.n_experts:
+            raise ValueError(
+                "mode='ar' is a dense-TP latency path (GEMM + fused "
+                "AllReduce); an MoE FFN's comm IS the expert dispatch — "
+                "use mode='dist' (a2a kernels) or 'xla'")
         attn, mlp = self.attn, self.mlp
 
         def body(h, xs):

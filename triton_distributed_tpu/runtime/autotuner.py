@@ -466,7 +466,7 @@ def interleaved_slope_timer(loops, *, rounds: int = 13, ms_bounds=None):
     # (a singleton round pins its lone survivor's ratio to exactly 1.0 —
     # uninformative, and it dilutes real differences); candidates seen
     # only in singleton rounds fall back to their absolute median.
-    ranked = [rd for rd in per_round if len(rd) >= 2] or per_round
+    ranked = [rd for rd in per_round if len(rd) >= 2]
     grand = statistics.median(
         v for rd in ranked for v in rd.values()) if ranked else None
     out: list[float] = []
