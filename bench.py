@@ -407,8 +407,8 @@ def _run_benchmarks():
                                 mesh=_single_mesh())
     xm = jax.random.normal(jax.random.fold_in(key, 15), (512, 2048),
                            jnp.bfloat16)
-    moe_wbytes = (moe_params["w_gate_up"].size
-                  + moe_params["w_down"].size) * 2
+    moe_wbytes = (moe_params["w_gate_up"].nbytes
+                  + moe_params["w_down"].nbytes)
     moe_floor_ms = moe_wbytes / _hbm_gbps() / 1e6
 
     def body_moe(acc, x, p):
