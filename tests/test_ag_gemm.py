@@ -176,3 +176,52 @@ def test_ag_gemm_segmented_bare(rng):
     got = jax.jit(lambda a, b: ag_gemm_segmented_bare(
         a, b, segments=8, config=AGGEMMConfig(block_n=128)))(a, b)
     assert_allclose(got, np.asarray(a) @ np.asarray(b))
+
+
+def test_ag_gemm_loopback_split_tail(rng):
+    """The round-5 overlap/tail split: overlap_cols < n routes the tail
+    columns through ``matmul_tail_into`` (pass-through assembly over the
+    STAGED gathered A — the staging buffer doubles as the gathered
+    operand)."""
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_loopback,
+        ag_gemm_segmented_bare,
+    )
+
+    M, K, N = 64, 32, 384
+    a, b = _ab(rng, M, K, N)
+    cfg = AGGEMMConfig(block_n=128, overlap_cols=128)
+    golden = np.asarray(a) @ np.asarray(b)
+    got = jax.jit(lambda a, b: ag_gemm_loopback(
+        a, b, segments=8, config=cfg))(a, b)
+    assert_allclose(got, golden)
+    got = jax.jit(lambda a, b: ag_gemm_segmented_bare(
+        a, b, segments=8, config=cfg))(a, b)
+    assert_allclose(got, golden)
+
+
+def test_ag_gemm_device_split_tail(mesh8, rng):
+    """Device-path split: the overlap kernel computes only overlap_cols
+    columns, the tail rides the gathered-A staging output."""
+    M, K, N = 8 * WORLD, 32, 256 * WORLD
+    a, b = _ab(rng, M, K, N)
+    out = ag_gemm(a, b, mesh=mesh8,
+                  config=AGGEMMConfig(block_n=128, overlap_cols=128))
+    golden = np.asarray(a, np.float32) @ np.asarray(b, np.float32)
+    assert_allclose(out, golden)
+
+
+def test_matmul_tail_into(rng):
+    """The split's assembly kernel: c rides through to columns
+    [0, col_start), b[:, col_start:] is computed via the offset index map
+    (no slice materialization), one full-width output."""
+    from triton_distributed_tpu.kernels.allgather_gemm import matmul_tail_into
+
+    M, K, N = 64, 128, 384
+    a, b = _ab(rng, M, K, N)
+    c = jnp.asarray(rng.standard_normal((M, 128), dtype=np.float32))
+    got = jax.jit(lambda c, a, b: matmul_tail_into(c, a, b, 128,
+                                                   block_n=128))(c, a, b)
+    golden = np.asarray(a) @ np.asarray(b)
+    assert_allclose(got[:, 128:], golden[:, 128:])
+    assert_allclose(got[:, :128], np.asarray(c))

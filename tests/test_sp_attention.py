@@ -137,6 +137,31 @@ def test_flash_decode_local_gqa_and_kv_len(rng):
                     atol=1e-3, rtol=1e-3)
 
 
+def test_flash_decode_block_diag_path(rng):
+    """The round-5 block-diagonal batched-head kernel (bshd layout,
+    Hkv*g >= 16 — all heads in one MXU dot pair, off-block selection by
+    mask-sum) must match the dense golden, including kv_len masking and
+    the LSE the inter-rank combine consumes."""
+    from triton_distributed_tpu.kernels.sp_attention import flash_decode_local
+
+    B, Hq, Hkv, dh, S, kv_len = 2, 16, 4, 32, 256, 77
+    q = rng.standard_normal((B, Hq, dh), dtype=np.float32)
+    k = rng.standard_normal((B, S, Hkv, dh), dtype=np.float32)
+    v = rng.standard_normal((B, S, Hkv, dh), dtype=np.float32)
+    out, lse = flash_decode_local(jnp.asarray(q), jnp.asarray(k),
+                                  jnp.asarray(v), kv_len=kv_len, chunk=64,
+                                  kv_layout="bshd")
+    kx = np.repeat(np.moveaxis(k, 2, 1), Hq // Hkv, axis=1)
+    vx = np.repeat(np.moveaxis(v, 2, 1), Hq // Hkv, axis=1)
+    assert_allclose(out, _decode_golden(q, kx, vx, dh ** -0.5, kv_len),
+                    atol=1e-3, rtol=1e-3)
+    scores = np.einsum("bhd,bhnd->bhn", q, kx) * dh ** -0.5
+    scores = scores[:, :, :kv_len]
+    golden_lse = np.log(np.exp(scores - scores.max(-1, keepdims=True))
+                        .sum(-1)) + scores.max(-1)
+    assert_allclose(lse, golden_lse, atol=1e-3, rtol=1e-3)
+
+
 def test_sp_gqa_decode_layer_kv_len(mesh8, rng):
     """Distributed decode over a partially-filled sharded cache: the global
     kv_len cuts mid-shard (rank 4 partial, ranks 5-7 fully masked)."""
@@ -289,6 +314,42 @@ def test_attn_with_cache_prefill_routes_through_kernel(rng):
                             use_flash_decode=False)
     assert not np.isnan(np.asarray(fast)).any()
     assert_allclose(fast, dense, atol=2e-5, rtol=2e-4)
+
+
+def test_flash_prefill_varlen_matches_padded_golden(rng):
+    """Varlen (cu_seqlens-style) ragged batch: each row's first seq_lens[b]
+    queries must match the padded dense golden computed at that row's
+    length; padding rows come back zero. (Reference SP attention's varlen
+    regime, sp_ag_attention_intra_node.py:112-145.)"""
+    from triton_distributed_tpu.kernels.sp_attention import (
+        cu_seqlens_to_lens,
+        flash_prefill,
+    )
+
+    B, L, Hq, Hkv, dh, S = 3, 32, 4, 2, 128, 64
+    lens = np.array([32, 17, 8], np.int32)
+    cu = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+    q = rng.standard_normal((B, L, Hq, dh), dtype=np.float32)
+    k = rng.standard_normal((B, S, Hkv, dh), dtype=np.float32)
+    v = rng.standard_normal((B, S, Hkv, dh), dtype=np.float32)
+    seq_lens = cu_seqlens_to_lens(cu)
+    np.testing.assert_array_equal(np.asarray(seq_lens), lens)
+    out = flash_prefill(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                        offset=0, seq_lens=seq_lens, chunk=8)
+    assert out is not None
+    scale = dh ** -0.5
+    for b in range(B):
+        n = int(lens[b])
+        kx = np.repeat(np.moveaxis(k[b], 1, 0), Hq // Hkv, axis=0)
+        vx = np.repeat(np.moveaxis(v[b], 1, 0), Hq // Hkv, axis=0)
+        scores = np.einsum("lhd,hnd->hln", q[b, :n], kx[:, :n]) * scale
+        mask = np.tril(np.ones((n, n), bool))
+        scores = np.where(mask[None], scores, -1e30)
+        p = np.exp(scores - scores.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        golden = np.einsum("hln,hnd->lhd", p, vx[:, :n])
+        assert_allclose(out[b, :n], golden, atol=2e-3, rtol=2e-3)
+        np.testing.assert_array_equal(np.asarray(out[b, n:]), 0.0)
 
 
 def test_flash_prefill_falls_back_on_ragged_shapes(rng):

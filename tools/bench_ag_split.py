@@ -1,0 +1,91 @@
+"""Quick interleaved measurement of the round-5 AG-GEMM overlap/tail split
+(loopback / segmented-bare / bare trio at the bench shape). Mirrors
+bench.py's slope methodology; used to validate the split before a full
+bench run."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+M, K, N = 4096, 5120, 3200
+FLOPS = 2 * M * K * N
+SHORT, LONG = 32, 96
+
+
+def _acc_loop(fn):
+    @functools.partial(jax.jit, static_argnames=("n",))
+    def loop(a, b, n):
+        def body(_, acc):
+            return fn(acc, a, b)
+        return jax.lax.fori_loop(0, n, body, jnp.zeros((M, N), jnp.float32))
+    return loop
+
+
+def _timed(loop, a, b, iters):
+    t0 = time.perf_counter()
+    out = loop(a, b, iters)
+    float(out[0, 0])
+    return (time.perf_counter() - t0) * 1e3
+
+
+def _slope_once(loop, a, b):
+    s = _timed(loop, a, b, SHORT)
+    l = _timed(loop, a, b, LONG)
+    return max((l - s) / (LONG - SHORT), 1e-6)
+
+
+def main():
+    from triton_distributed_tpu.kernels.allgather_gemm import (
+        ag_gemm_loopback,
+        ag_gemm_segmented_bare,
+        ag_gemm_single_chip,
+    )
+
+    key = jax.random.PRNGKey(0)
+    a = jax.random.normal(key, (M, K), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (K, N), jnp.bfloat16)
+
+    def dep(acc):
+        return (acc[0, 0] * 1e-24).astype(jnp.float32)
+
+    def body_loopback(acc, a, b):
+        bb = b + dep(acc).astype(b.dtype)
+        return acc + ag_gemm_loopback(a, bb, segments=8).astype(jnp.float32)
+
+    def body_segbare(acc, a, b):
+        bb = b + dep(acc).astype(b.dtype)
+        return acc + ag_gemm_segmented_bare(a, bb, segments=8
+                                            ).astype(jnp.float32)
+
+    def body_bare(acc, a, b):
+        bb = b + dep(acc).astype(b.dtype)
+        return acc + ag_gemm_single_chip(a, bb).astype(jnp.float32)
+
+    loops = [_acc_loop(body_loopback), _acc_loop(body_segbare),
+             _acc_loop(body_bare)]
+    names = ["loopback", "segbare", "bare"]
+    for lp in loops:
+        _timed(lp, a, b, SHORT)
+        _timed(lp, a, b, LONG)
+    samples = [[] for _ in loops]
+    for _ in range(16):
+        for i, lp in enumerate(loops):
+            ms = _slope_once(lp, a, b)
+            tf = FLOPS / ms / 1e9
+            if 10.0 <= tf <= 201.0:
+                samples[i].append(ms)
+    for name, s in zip(names, samples):
+        s = sorted(s)
+        lq = s[max(0, (len(s) - 1) // 4)] if s else float("nan")
+        print(f"{name}: lq={lq:.4f} ms  samples={['%.3f' % x for x in s]}")
+    if samples[0] and samples[2]:
+        lqs = [sorted(s)[max(0, (len(s) - 1) // 4)] for s in samples]
+        print(f"overlap_efficiency = {lqs[2] / lqs[0]:.4f}")
+        print(f"grid_structure_ms = {lqs[1] - lqs[2]:.4f}")
+        print(f"staging_machinery_ms = {lqs[0] - lqs[1]:.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -302,11 +302,20 @@ def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
     are (Lb query positions x g GQA heads) flattened li-major, so one MXU
     score block serves the whole GQA group (reference relies on the
     flash_attn library for this; here it is the flash-decode kernel
-    generalized to q tiles, sharing its masking discipline)."""
+    generalized to q tiles, sharing its masking discipline).
+
+    Per-ROW scalars (row = batch index, scalar-prefetched): offset, cache
+    mask length, and valid query count — the varlen (cu_seqlens) machinery
+    of the reference's SP attention (sp_ag_attention_intra_node.py:112-145)
+    expressed TPU-style: padded batch + per-row lengths, with whole KV
+    chunks AND whole q tiles skipped once they pass a row's length (zero
+    extra FLOPs for short rows; padding rows emit zeros)."""
+    b = pl.program_id(0)
     qb = pl.program_id(2)
     c = pl.program_id(3)
-    offset = scalars_ref[0]
-    kv_len = scalars_ref[1]
+    offset = scalars_ref[0, b]
+    kv_len = scalars_ref[1, b]
+    q_len = scalars_ref[2, b]
 
     @pl.when(c == 0)
     def _init():
@@ -314,11 +323,12 @@ def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    # Skip chunks fully right of this q tile's last position (causal) or
-    # fully beyond the valid cache (kv_len); the running triple is simply
-    # not updated for them.
+    # Skip chunks fully right of this q tile's last position (causal),
+    # fully beyond the valid cache (kv_len), or belonging to a q tile
+    # that is entirely padding (varlen short row).
     last_q_pos = offset + qb * lb + lb - 1
-    needed = (c * ck <= last_q_pos) & (c * ck < kv_len)
+    needed = ((c * ck <= last_q_pos) & (c * ck < kv_len)
+              & (qb * lb < q_len))
 
     @pl.when(needed)
     def _chunk():
@@ -330,7 +340,8 @@ def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
         rows = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
         q_pos = offset + qb * lb + rows // g
         key_pos = c * ck + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        valid = (key_pos <= q_pos) & (key_pos < kv_len)
+        valid = ((key_pos <= q_pos) & (key_pos < kv_len)
+                 & (qb * lb + rows // g < q_len))
         scores = jnp.where(valid, scores, _NEG_INF)
         seg_max = jnp.max(scores, axis=1, keepdims=True)
         new_max = jnp.maximum(m_ref[...], seg_max)
@@ -347,6 +358,16 @@ def _flash_prefill_kernel(scalars_ref, q_ref, k_ref, v_ref, o_ref, acc_ref,
     def _finish():
         denom = jnp.maximum(l_ref[...], 1e-30)
         o_ref[0, 0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def cu_seqlens_to_lens(cu_seqlens):
+    """Reference cu_seqlens (B+1 cumulative offsets,
+    sp_ag_attention_intra_node.py:112) -> per-row lengths (B,) for
+    ``flash_prefill(seq_lens=...)`` — the padded-batch form is the
+    TPU-native varlen representation (static shapes; XLA cannot trace
+    token-packed dynamic rows)."""
+    cu = jnp.asarray(cu_seqlens, jnp.int32)
+    return cu[1:] - cu[:-1]
 
 
 def prefill_alignment_issue(L: int, Hq: int, dh: int, Hkv: int,
@@ -378,8 +399,8 @@ def _q_tile(L: int, g: int, preferred_rows: int = 1024) -> int:
 
 
 def flash_prefill(q, k_cache, v_cache, *, offset=None, kv_len=None,
-                  scale: float | None = None, chunk: int = 512,
-                  kv_layout: str = "bshd", interpret=None):
+                  seq_lens=None, scale: float | None = None,
+                  chunk: int = 512, kv_layout: str = "bshd", interpret=None):
     """Causal GQA prefill attention against a (possibly longer) KV cache via
     the streaming-softmax Pallas kernel — O(L_q * dh) memory per tile
     instead of the (B, L, Hq, S) fp32 score tensor of the dense path.
@@ -389,6 +410,14 @@ def flash_prefill(q, k_cache, v_cache, *, offset=None, kv_len=None,
     once internally; pass ``bhsd`` to skip it) already containing the new
     keys. ``kv_len`` masks cache positions >= it (default offset + L).
     Returns (B, L, Hq, dh) in q.dtype.
+
+    ``seq_lens`` (B,) int32 enables VARLEN mode — the reference SP
+    attention's cu_seqlens regime (sp_ag_attention_intra_node.py:112-145)
+    in padded-batch form: row b's valid queries are its first
+    ``seq_lens[b]`` rows (the rest is padding and returns zeros), its
+    cache mask is ``offset + seq_lens[b]``, and KV chunks / q tiles past a
+    row's length are skipped in-kernel (no FLOPs for short rows). Use
+    ``cu_seqlens_to_lens`` to convert a reference-style cu_seqlens vector.
 
     Returns None when the shapes don't admit an aligned tiling (ragged L/dh)
     — callers fall back to the dense jnp path.
@@ -408,8 +437,21 @@ def flash_prefill(q, k_cache, v_cache, *, offset=None, kv_len=None,
     ck = _kv_chunk(S, chunk)
     n_chunks = S // ck
     offset = jnp.asarray(0 if offset is None else offset, jnp.int32)
-    kv_len = jnp.asarray(offset + L if kv_len is None else kv_len, jnp.int32)
-    scalars = jnp.stack([offset, kv_len])
+    offsets = jnp.broadcast_to(offset, (B,))
+    if seq_lens is not None:
+        seq_lens = jnp.asarray(seq_lens, jnp.int32)
+        if seq_lens.shape != (B,):
+            raise ValueError(f"seq_lens {seq_lens.shape} != ({B},)")
+        if kv_len is not None:
+            raise ValueError("pass kv_len OR seq_lens, not both")
+        kv_lens = offsets + seq_lens
+        q_lens = seq_lens
+    else:
+        kv_len = jnp.asarray(offset + L if kv_len is None else kv_len,
+                             jnp.int32)
+        kv_lens = jnp.broadcast_to(kv_len, (B,))
+        q_lens = jnp.full((B,), L, jnp.int32)
+    scalars = jnp.stack([offsets, kv_lens, q_lens])
 
     # Rows li-major: row = li*g + gi -> contiguous q-position tiles.
     q_r = q.reshape(B, L, Hkv, g, dh).transpose(0, 2, 1, 3, 4
@@ -476,6 +518,11 @@ def _flash_decode_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
 
     for h in range(n_kv):
+        # The f32 casts are deliberate: an all-bf16 variant (wire-dtype
+        # operands straight to the MXU, p cast to v.dtype like the
+        # reference's Triton kernel) measured 3.2x SLOWER at the bench
+        # shape — the g-row (sub-16-sublane) bf16 operands hit Mosaic's
+        # packed-tile relayout path on every op. f32 (8, 128) tiles don't.
         q = q_ref[0, h].astype(jnp.float32)                # (g, dh)
         if bshd:
             k = k_ref[0, :, h, :].astype(jnp.float32)      # (ck, dh)
@@ -504,6 +551,76 @@ def _flash_decode_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         denom = jnp.maximum(l_ref[...], 1e-30)             # (n_kv, g, 1)
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
         lse_ref[0] = (m_ref[...] + jnp.log(denom))[..., 0]
+
+
+def _flash_decode_bd_kernel(kvlen_ref, qbd_ref, k_ref, v_ref, o_ref, lse_ref,
+                            acc_ref, m_ref, l_ref, *, n_chunks: int, ck: int,
+                            scale: float, n_kv: int, g: int, dh: int):
+    """Block-diagonal batched-head split-KV decode (bshd layout, round 5).
+
+    The per-head kernel ran the WHOLE KV stream through f32 VPU converts
+    (the bf16 operands' g-row sub-tiles hit Mosaic's relayout path, and the
+    f32 variant converts 2M elements per step) — measured compute-DMA
+    SERIALIZED at ~58% of HBM peak. Here all local heads fold into ONE pair
+    of MXU dots per chunk: q arrives pre-arranged block-diagonal
+    (rows = (head, q-in-group), cols = (head, feature) — zeros off-block),
+    so ``q_bd @ K_flat^T`` computes every head's scores in one
+    (Hkv*g, Hkv*dh) x (Hkv*dh, ck) bf16 dot with f32 accumulate: KV feeds
+    the MXU in its wire dtype, operand rows are >= 16 (no relayouts), and
+    the off-block FLOPs are free on an HBM-bound op. The PV dot computes
+    (Hkv*g, ck) x (ck, Hkv*dh) and the per-row head block is selected with
+    a mask-sum. Reference structure: flash_decode.py:130 split-KV with the
+    chunk loop as the Pallas grid."""
+    c = pl.program_id(1)
+    kv_len = kvlen_ref[0]
+    rows = n_kv * g
+
+    @pl.when(c == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    q_bd = qbd_ref[0]                                      # (rows, n_kv*dh)
+    k_flat = k_ref[0].reshape(ck, n_kv * dh)               # wire dtype
+    v_flat = v_ref[0].reshape(ck, n_kv * dh)
+    scores = jax.lax.dot_general(
+        q_bd, k_flat, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale        # (rows, ck) f32
+    pos = c * ck + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    valid = pos < kv_len
+    scores = jnp.where(valid, scores, _NEG_INF)
+    seg_max = jnp.max(scores, axis=-1, keepdims=True)      # (rows, 1)
+    new_max = jnp.maximum(m_ref[...], seg_max)
+    corr = jnp.exp(m_ref[...] - new_max)
+    p = jnp.exp(scores - new_max) * valid.astype(jnp.float32)
+    l_ref[...] = l_ref[...] * corr + jnp.sum(p, axis=-1, keepdims=True)
+    pv = jax.lax.dot_general(
+        p.astype(v_flat.dtype), v_flat, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)                # (rows, n_kv*dh)
+    # Keep each row's own head block: row r belongs to head r // g.
+    row_head = jax.lax.broadcasted_iota(jnp.int32, (rows, n_kv, 1), 0) // g
+    col_head = jax.lax.broadcasted_iota(jnp.int32, (rows, n_kv, 1), 1)
+    own = (row_head == col_head).astype(jnp.float32)
+    pv_own = jnp.sum(pv.reshape(rows, n_kv, dh) * own, axis=1)  # (rows, dh)
+    acc_ref[...] = acc_ref[...] * corr + pv_own
+    m_ref[...] = new_max
+
+    @pl.when(c == n_chunks - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)             # (rows, 1)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[...] + jnp.log(denom)           # (rows, 1)
+
+
+def _block_diag_q(q4):
+    """(B, Hkv, g, dh) -> (B, Hkv*g, Hkv*dh) with q4[b, h, i] at rows
+    h*g+i, cols h*dh..(h+1)*dh and zeros off-block — the one-dot-all-heads
+    operand of the block-diagonal decode kernel."""
+    B, Hkv, g, dh = q4.shape
+    eye = jnp.eye(Hkv, dtype=q4.dtype)
+    return jnp.einsum("bhgd,hH->bhgHd", q4, eye).reshape(
+        B, Hkv * g, Hkv * dh)
 
 
 def _kv_chunk(m_kv: int, preferred: int = 512) -> int:
@@ -565,6 +682,63 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
         kv_spec = pl.BlockSpec((1, Hkv, ck, dh), lambda b, c, kl: (b, 0, c, 0))
 
     qg = q.reshape(B, Hkv, g, dh)
+
+    # Explicit scoped-VMEM grant when the double-buffered KV staging alone
+    # approaches the 16MB default (chunk sweeps above 1024 rows): staged KV
+    # + kernel temporaries (f32 conversion copies on the per-head path,
+    # headroom on the bd path) + accumulators. One definition for both
+    # decode paths.
+    staged = 4 * ck * Hkv * dh * k_cache.dtype.itemsize
+    vlim = None
+    if staged > 8 * 2 ** 20:
+        vlim = staged + 2 * ck * Hkv * dh * 4 + 8 * 2 ** 20
+
+    # Block-diagonal batched-head path (see _flash_decode_bd_kernel): bshd
+    # layout (K_flat/V_flat reshapes are free; bhsd would transpose) with
+    # enough rows to dodge bf16 sub-tile relayouts. Measured 18.0 -> 11.1 ms
+    # at the B=128/16k bench shape (58% -> ~93% of HBM peak).
+    if bshd and Hkv * g >= 16:
+        rows, feat = Hkv * g, Hkv * dh
+        q_bd = _block_diag_q(qg)
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, n_chunks),
+            in_specs=[
+                pl.BlockSpec((1, rows, feat), lambda b, c, kl: (b, 0, 0)),
+                kv_spec,
+                kv_spec,
+            ],
+            out_specs=[
+                pl.BlockSpec((1, rows, dh), lambda b, c, kl: (b, 0, 0)),
+                pl.BlockSpec((1, rows, 1), lambda b, c, kl: (b, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((rows, dh), jnp.float32),   # acc
+                pltpu.VMEM((rows, 1), jnp.float32),    # running max
+                pltpu.VMEM((rows, 1), jnp.float32),    # denominator
+            ],
+        )
+        out, lse = pl.pallas_call(
+            functools.partial(_flash_decode_bd_kernel, n_chunks=n_chunks,
+                              ck=ck, scale=scale, n_kv=Hkv, g=g, dh=dh),
+            out_shape=[
+                jax.ShapeDtypeStruct((B, rows, dh), jnp.float32),
+                jax.ShapeDtypeStruct((B, rows, 1), jnp.float32),
+            ],
+            grid_spec=grid_spec,
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("parallel", "arbitrary"),
+                vmem_limit_bytes=vlim),
+            cost_estimate=common.cost_estimate(
+                flops=4 * B * Hkv * Hkv * g * m_kv * dh,
+                bytes_accessed=(B * Hkv * g * Hkv * dh * q.dtype.itemsize
+                                + 2 * B * Hkv * m_kv * dh
+                                * k_cache.dtype.itemsize
+                                + B * Hq * (dh + 1) * 4)),
+            interpret=resolve_interpret(interpret),
+        )(kv_len, q_bd, k_cache, v_cache)
+        return out.reshape(B, Hq, dh), lse.reshape(B, Hq)
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(B, n_chunks),
@@ -592,7 +766,8 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
         ],
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=("parallel", "arbitrary"),
+            vmem_limit_bytes=vlim),
         cost_estimate=common.cost_estimate(
             flops=4 * B * Hq * m_kv * dh,
             bytes_accessed=(B * Hq * dh * q.dtype.itemsize

@@ -156,12 +156,16 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
 
     p = jax.nn.softmax(scores, axis=-1)
-    # Fast path (use_flash_decode=True fell back here): probabilities ride
-    # in the cache's wire dtype so XLA streams V without an fp32 copy.
-    # GOLDEN mode (use_flash_decode=False — what the kernels are validated
-    # against, tp_attn.py xla_fwd) keeps full fp32 probabilities: the
-    # reference math must not carry a quantization the kernels don't.
-    if use_flash_decode:
+    # DECODE fast path (use_flash_decode=True, L=1 fell back here):
+    # probabilities ride in the cache's wire dtype so XLA streams V without
+    # an fp32 copy (measured 2.09 -> 1.1 ms on the 28-layer decode stack).
+    # L>1 PREFILL fallback keeps fp32 probabilities even on the fast path
+    # (ADVICE r4): the flash kernels it stands in for carry fp32 p, and the
+    # large prefill score tensor is where a bf16-p quantization would bite
+    # — an accuracy asymmetry on exactly the ragged shapes that already
+    # silently fell back. GOLDEN mode (use_flash_decode=False — what the
+    # kernels are validated against, tp_attn.py xla_fwd) is fp32 always.
+    if use_flash_decode and L == 1:
         p = p.astype(v_cache.dtype)
     out = jnp.einsum("blhgs,bshd->blhgd", p, v_cache,
                      preferred_element_type=jnp.float32)

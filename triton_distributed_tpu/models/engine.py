@@ -67,18 +67,22 @@ class Engine:
 
     # -- compiled step ------------------------------------------------------
 
-    def _make_sm(self, mode: str):
+    def _make_sm(self, mode: str, *, moe_stats: bool = False):
         """The per-mode shard_map of the model forward — the ONE definition
-        of the step sharding, shared by the per-step jit (``_step_fn``) and
-        the scanned loop (``_serve_scanned_fn``)."""
+        of the step sharding, shared by the per-step jit (``_step_fn``),
+        the scanned loop (``_serve_scanned_fn``), and the drop-stats audit
+        (``moe_stats=True`` appends the replicated counters output)."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
+        out_specs = ((P(), kspec, vspec, P()) if moe_stats
+                     else (P(), kspec, vspec))
         return jax.shard_map(
             functools.partial(model.forward_device, mode=mode,
-                              interpret=self.interpret),
+                              interpret=self.interpret,
+                              return_moe_stats=moe_stats),
             mesh=self.mesh,
             in_specs=(model.param_specs(), P(), kspec, vspec, P()),
-            out_specs=(P(), kspec, vspec),
+            out_specs=out_specs,
             check_vma=False,
         )
 
@@ -113,6 +117,31 @@ class Engine:
         return self._aot_steps[key](self.params, ids, kv)
 
     # -- public API ---------------------------------------------------------
+
+    def moe_drop_stats(self, input_ids):
+        """Capacity audit for MoE configs (ADVICE r4): run one dist-mode
+        forward over ``input_ids`` (a representative traffic batch) and
+        return ``{"n_dropped_dispatch": int, "n_dropped_expert": int}`` —
+        (token, expert) pairs silently dropped by the static EP capacities,
+        summed over layers and ranks. HF semantics have no drop concept, so
+        a production deployment should see ZEROS here; if not, raise
+        ``config.moe_capacity_factor`` (or set explicit capacities on
+        ``MoEMLP``) until it does. The counters ride the same scan as the
+        real forward, so skew that only appears at depth is counted."""
+        if not self.config.n_experts:
+            raise ValueError("moe_drop_stats is only meaningful for MoE "
+                             "configs (n_experts > 0)")
+        # Cached like _step_fn: a serving stack audits over MANY batches,
+        # and a fresh jit per call would re-trace + re-compile the whole
+        # forward every time.
+        if "moe_stats" not in self._steps:
+            self._steps["moe_stats"] = jax.jit(
+                self._make_sm("dist", moe_stats=True))
+        input_ids = jnp.asarray(input_ids, jnp.int32)
+        kv = self.new_cache(input_ids.shape[0])
+        _, _, _, stats = self._steps["moe_stats"](self.params, input_ids,
+                                                  kv.k, kv.v, kv.offset)
+        return {k: int(v) for k, v in stats.items()}
 
     def new_cache(self, batch_size: int) -> KVCache:
         return KVCache.create(self.config, batch_size, mesh=self.mesh,

@@ -264,12 +264,22 @@ class Qwen3:
     # -- per-device forward (inside shard_map) ------------------------------
 
     def forward_device(self, params, ids, k_cache, v_cache, offset, *,
-                       mode: str = "dist", interpret=None):
+                       mode: str = "dist", interpret=None,
+                       return_moe_stats: bool = False):
         """One forward step on this device.
 
         ids: (B, L) int32, replicated. k/v_cache: this device's shard
         (n_layers, B, S, local_kv_heads, dh). offset: () int32.
         Returns (logits (B, vocab) fp32 replicated, new_k, new_v).
+
+        ``return_moe_stats=True`` (MoE + mode='dist' only) appends a 4th
+        output: ``{"n_dropped_dispatch", "n_dropped_expert"}`` int32 totals
+        summed over layers and psum'd over the EP axis — the capacity-audit
+        observable (ADVICE r4: the default ``capacity_factor`` can drop
+        (token, k) pairs under skewed routing, and HF semantics have no drop
+        concept; serving stacks must audit these at their real traffic via
+        ``Engine.moe_drop_stats`` and raise ``moe_capacity_factor`` or set
+        explicit capacities if nonzero).
         """
         c = self.config
         world = jax.lax.axis_size(self.axis)
@@ -293,6 +303,10 @@ class Qwen3:
                 "AllReduce); an MoE FFN's comm IS the expert dispatch — "
                 "use mode='dist' (a2a kernels) or 'xla'")
         attn, mlp = self.attn, self.mlp
+        if return_moe_stats and (not c.n_experts or mode != "dist"):
+            raise ValueError("return_moe_stats requires an MoE config in "
+                             "mode='dist' (drops only exist on the EP "
+                             "dispatch path)")
 
         def body(h, xs):
             lp, kc, vc = xs
@@ -310,17 +324,31 @@ class Qwen3:
             resid = h
             hn = nn.rms_norm(h, lp["post_norm"], c.rms_eps)
             flat = hn.reshape(-1, c.d_model)
+            stats = None
             if mode == "dist":
-                m = mlp.dist_fwd(lp["mlp"], flat, interpret=interpret)
+                if return_moe_stats:
+                    m, stats = mlp.dist_fwd(lp["mlp"], flat,
+                                            return_stats=True,
+                                            interpret=interpret)
+                else:
+                    m = mlp.dist_fwd(lp["mlp"], flat, interpret=interpret)
             elif mode == "xla":
                 m = mlp.xla_fwd(lp["mlp"], flat)
             else:
                 m = mlp.ar_fwd(lp["mlp"], flat, interpret=interpret)
             h = resid + m.reshape(hn.shape)
+            if return_moe_stats:
+                return h, (kc, vc, stats)
             return h, (kc, vc)
 
-        h, (new_k, new_v) = jax.lax.scan(
-            body, h, (params["layers"], k_cache, v_cache))
+        if return_moe_stats:
+            h, (new_k, new_v, layer_stats) = jax.lax.scan(
+                body, h, (params["layers"], k_cache, v_cache))
+            moe_stats = jax.tree.map(
+                lambda x: jax.lax.psum(jnp.sum(x), self.axis), layer_stats)
+        else:
+            h, (new_k, new_v) = jax.lax.scan(
+                body, h, (params["layers"], k_cache, v_cache))
 
         h = nn.rms_norm(h, params["final_norm"], c.rms_eps)
         last = h[:, -1]                                        # (*, d)
@@ -330,4 +358,6 @@ class Qwen3:
                    else params["lm_head"])
         # bf16 operands, fp32 accumulation — no materialized fp32 weight copy
         logits = jnp.dot(last, lm_head, preferred_element_type=jnp.float32)
+        if return_moe_stats:
+            return logits, new_k, new_v, moe_stats
         return logits, new_k, new_v

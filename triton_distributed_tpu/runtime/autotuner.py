@@ -464,8 +464,28 @@ def interleaved_slope_timer(loops, *, rounds: int = 13, ms_bounds=None):
     # grand cohort median so callers still see real-unit times. Only
     # rounds where >=2 candidates survived the gate carry ranking signal
     # (a singleton round pins its lone survivor's ratio to exactly 1.0 —
-    # uninformative, and it dilutes real differences); candidates seen
-    # only in singleton rounds fall back to their absolute median.
+    # uninformative, and it dilutes real differences). Candidates seen
+    # only in singleton rounds rank inf when other candidates carry
+    # normalized estimates (mixing estimators misranks under drift,
+    # ADVICE r4 #3); when NO round had two survivors, all candidates fall
+    # back to absolute medians together — one estimator either way.
+    if live and not per_round:
+        # No candidate produced a single valid sample (ADVICE r4 #3): this
+        # looks exactly like "no winner" downstream (the tune silently
+        # never commits) — make it loud, naming every possible cause: the
+        # plausibility gate (over-tight ms_bounds / the non-positive-slope
+        # floor when ms_bounds is None) or all candidates dying mid-rounds.
+        cause = (f"plausibility gate ms_bounds={ms_bounds}"
+                 if ms_bounds is not None else
+                 "non-positive-slope gate (ms_bounds=None)")
+        n_died = sum(1 for i, _ in live if i in dead)
+        warnings.warn(
+            f"interleaved_slope_timer: no valid sample from any of "
+            f"{len(live)} live candidates over {rounds} rounds "
+            f"({n_died} raised and died mid-rounds; the rest were "
+            f"rejected by the {cause}) — no result will commit; if "
+            f"bounds-gated, the bound may be too tight for this op "
+            f"(overhead-dominated small shape?)", stacklevel=2)
     ranked = [rd for rd in per_round if len(rd) >= 2]
     grand = statistics.median(
         v for rd in ranked for v in rd.values()) if ranked else None
@@ -479,6 +499,16 @@ def interleaved_slope_timer(loops, *, rounds: int = 13, ms_bounds=None):
         if ratios:
             out.append(statistics.median(ratios) * grand)
             continue
+        if ranked:
+            # Mixing estimators misranks (ADVICE r4 #3): when OTHER
+            # candidates carry cohort-normalized estimates, a candidate
+            # seen only in singleton rounds has no drift-comparable
+            # signal — rank it out rather than compare its raw absolute
+            # median against rescaled ratios under drift.
+            out.append(float("inf"))
+            continue
+        # No multi-survivor round anywhere: every candidate is on the same
+        # (absolute-median) estimator, so the comparison stays consistent.
         absolute = [v for rd in per_round
                     if (v := rd.get(i)) is not None]
         out.append(statistics.median(absolute) if absolute
