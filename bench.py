@@ -399,9 +399,13 @@ def _run_benchmarks():
                                       world=a2a_world)
         return acc + osc[:, :, 0]
 
+    # ~26 us/iter: default 32/96 trips ride ~2 ms of work against +-5-10 ms
+    # of tunnel jitter (r4 read 26 us, a same-code rerun 61 us — pure
+    # noise); long trips make the slope base ~100 ms.
     (a2a_ms,) = _paired_slopes(
         [_acc_loop(body_a2a, out_shape=(a2a_world, 128))], toks, a2a_scales,
-        0, ms_bounds=(0.9 * a2a_floor_ms, 50 * a2a_floor_ms))
+        0, ms_bounds=(0.9 * a2a_floor_ms, 50 * a2a_floor_ms), rounds=6,
+        iters=(1536, 4608))
 
     # -- MoE block arm (qwen3-30b-a3b per-device shapes) -------------------
     # The sparse-FFN family's hardware number: the FULL dist-path block —
@@ -535,7 +539,7 @@ def _run_benchmarks():
     flash_ms, dense_ms = _paired_slopes(
         [_acc_loop(body_flash, out_shape=(Bp * Lp, Hqp * dhp)),
          _acc_loop(body_dense, out_shape=(Bp * Lp, Hqp * dhp))],
-        qp, kvp, attn_flops, rounds=5)
+        qp, kvp, attn_flops, rounds=5, iters=(96, 288))
 
     # TP-MLP block (AG-GEMM -> GLU -> GEMM-RS, world=1 path) at M=4096,
     # through the ON-CHIP tuned blockings (incl. full-K single-pass). Tuning

@@ -1,0 +1,117 @@
+"""Decode-step component breakdown on the real chip: slope-time each
+component of the qwen3-1.7b B=8 decode step separately (same methodology
+as bench.py), then compare the sum against the measured e2e step."""
+import functools, time
+import os, sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import jax, jax.numpy as jnp
+
+SHORT, LONG = 96, 288
+
+def _timed(loop, args, iters):
+    t0 = time.perf_counter()
+    out = loop(*args, iters)
+    float(jax.tree.leaves(out)[0].ravel()[0])
+    return (time.perf_counter() - t0) * 1e3
+
+def slope(loop, args, n=5):
+    _timed(loop, args, SHORT); _timed(loop, args, LONG)
+    best = []
+    for _ in range(n):
+        s = _timed(loop, args, SHORT); l = _timed(loop, args, LONG)
+        best.append((l - s) / (LONG - SHORT))
+    best.sort()
+    return best[max(0, (len(best)-1)//4)]
+
+from triton_distributed_tpu.models import ModelConfig
+from triton_distributed_tpu.kernels.sp_attention import flash_decode_local
+
+c = ModelConfig.from_name("qwen3-1.7b", max_length=512)
+B, S, L = 8, 512, 28
+d, Hq, Hkv, dh, dff, V = (c.d_model, c.n_heads, c.n_kv_heads, c.head_dim,
+                          c.d_ff, c.vocab_size)
+print(f"config: d={d} Hq={Hq} Hkv={Hkv} dh={dh} dff={dff} V={V} layers={c.n_layers}")
+key = jax.random.PRNGKey(0)
+
+# stacked per-layer weights (as the scan sees them)
+wqkv = jax.random.normal(key, (L, d, (Hq + 2*Hkv)*dh), jnp.bfloat16)
+wo = jax.random.normal(key, (L, Hq*dh, d), jnp.bfloat16)
+wgu = jax.random.normal(key, (L, d, 2*dff), jnp.bfloat16)
+wdn = jax.random.normal(key, (L, dff, d), jnp.bfloat16)
+kc = jax.random.normal(key, (L, B, S, Hkv, dh), jnp.bfloat16)
+vc = jax.random.normal(key, (L, B, S, Hkv, dh), jnp.bfloat16)
+lm = jax.random.normal(key, (d, V), jnp.bfloat16)
+x = jax.random.normal(key, (B, d), jnp.bfloat16)
+
+def dep(acc):
+    return (jax.tree.leaves(acc)[0].ravel()[0] * 1e-24).astype(jnp.float32)
+
+def scan_arm(f, carry_shape=(8, 2048)):
+    # scan over L layers of component f, inside fori_loop
+    def make(ws):
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def loop(x, ws, n):
+            def body(_, acc):
+                xx = (x + dep(acc).astype(x.dtype))
+                def lay(h, w):
+                    return f(h, w), None
+                out, _ = jax.lax.scan(lay, xx, ws)
+                return acc + out.astype(jnp.float32)
+            return jax.lax.fori_loop(0, n, body, jnp.zeros(carry_shape, jnp.float32))
+        return loop
+    return make
+
+# 1. qkv+out projections per layer
+def attn_proj(h, w):
+    wq, wo_ = w
+    q = jnp.dot(h, wq, preferred_element_type=jnp.float32).astype(h.dtype)
+    return jnp.dot(q[:, :Hq*dh], wo_, preferred_element_type=jnp.float32).astype(h.dtype)
+t_proj = slope(scan_arm(attn_proj)(None), (x, (wqkv, wo)))
+
+# 2. flash decode attention per layer (bd path)
+def attn_fd(h, w):
+    kcl, vcl = w
+    q = jnp.broadcast_to(h[:, None, :dh], (B, Hq, dh)).astype(jnp.bfloat16)
+    out, _ = flash_decode_local(q, kcl, vcl, kv_len=S, kv_layout="bshd")
+    return (h + out.reshape(B, -1)[:, :d].astype(h.dtype) * 1e-6).astype(h.dtype)
+t_attn = slope(scan_arm(attn_fd)(None), (x, (kc, vc)))
+
+# 3. MLP per layer
+def mlp(h, w):
+    g, dn = w
+    hh = jnp.dot(h, g, preferred_element_type=jnp.float32)
+    act = (jax.nn.silu(hh[:, :dff]) * hh[:, dff:]).astype(h.dtype)
+    return jnp.dot(act, dn, preferred_element_type=jnp.float32).astype(h.dtype)
+t_mlp = slope(scan_arm(mlp)(None), (x, (wgu, wdn)))
+
+# 4. lm_head (once per step)
+@functools.partial(jax.jit, static_argnames=("n",))
+def loop_lm(x, lm, n):
+    def body(_, acc):
+        xx = x + dep(acc).astype(x.dtype)
+        return acc + jnp.dot(xx, lm, preferred_element_type=jnp.float32)
+    return jax.lax.fori_loop(0, n, body, jnp.zeros((B, V), jnp.float32))
+t_lm = slope(loop_lm, (x, lm))
+
+# 5. cache update (dynamic_update_slice per layer, donated)
+def cache_upd(h, w):
+    kcl = w
+    new = h[:, None, None, :dh] * jnp.ones((B, 1, Hkv, dh), h.dtype)
+    kcl = jax.lax.dynamic_update_slice(kcl, new.astype(kcl.dtype), (0, 200, 0, 0))
+    return (h + kcl[:, 200, 0, :d // 16].repeat(16, -1) * 1e-6).astype(h.dtype)
+t_cache = slope(scan_arm(cache_upd)(None), (x, kc))
+
+hbm = 819e9
+wb = lambda a: a.nbytes
+floors = {
+  "attn_proj": (wqkv.nbytes + wo.nbytes) / hbm * 1e3,
+  "flash_attn": (kc.nbytes + vc.nbytes) / hbm * 1e3,
+  "mlp": (wgu.nbytes + wdn.nbytes) / hbm * 1e3,
+  "lm_head": lm.nbytes / hbm * 1e3,
+}
+print(f"attn_proj: {t_proj:.3f} ms (floor {floors['attn_proj']:.3f})")
+print(f"flash_attn: {t_attn:.3f} ms (floor {floors['flash_attn']:.3f})")
+print(f"mlp: {t_mlp:.3f} ms (floor {floors['mlp']:.3f})")
+print(f"lm_head: {t_lm:.3f} ms (floor {floors['lm_head']:.3f})")
+print(f"cache_upd: {t_cache:.3f} ms")
+print(f"sum: {t_proj + t_attn + t_mlp + t_lm + t_cache:.3f} ms  (e2e measured ~7.4-8.0)")
