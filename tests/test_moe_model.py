@@ -97,6 +97,31 @@ def test_moe_mlp_drop_stats_surfaced(mesh4, rng):
     assert run(tight)["n_dropped_dispatch"] >= 32
 
 
+def test_grouped_gemm_skip_matches_einsum(rng):
+    """The count-aware Pallas grouped GEMM (empty-expert weight-fetch skip)
+    must match the einsum golden on the non-empty experts and return zeros
+    for empty ones — including leading/trailing/consecutive empties (the
+    eff-index clamping cases)."""
+    from triton_distributed_tpu.kernels.moe_utils import (
+        grouped_gemm,
+        grouped_gemm_skip,
+    )
+
+    E, cap, d, f = 8, 16, 32, 128
+    counts = jnp.asarray([0, 0, 3, 0, 16, 1, 0, 0], jnp.int32)
+    grouped = jnp.asarray(rng.standard_normal((E, cap, d)), jnp.float32)
+    # Zero the slots beyond each expert's count (the grid contract).
+    valid = jnp.arange(cap)[None, :] < counts[:, None]
+    grouped = jnp.where(valid[..., None], grouped, 0)
+    w = jnp.asarray(rng.standard_normal((E, d, f)), jnp.float32)
+    got = jax.jit(lambda g, w, c: grouped_gemm_skip(g, w, c))(
+        grouped, w, counts)
+    golden = grouped_gemm(grouped, w)
+    assert_allclose(got, jnp.where(valid[..., None], golden, 0), atol=1e-4,
+                    rtol=1e-4)
+    np.testing.assert_array_equal(np.asarray(got[counts == 0]), 0.0)
+
+
 def test_moe_mlp_router_normalization(mesh4, rng):
     """norm_topk_prob=False must keep the raw softmax mass (HF flag)."""
     layer = _layer(norm_topk_prob=False, capacity=32, expert_capacity=64)
@@ -140,11 +165,17 @@ def test_moe_engine_drop_stats_audit(mesh4):
     stats = roomy.moe_drop_stats(prompt)
     assert stats == {"n_dropped_dispatch": 0, "n_dropped_expert": 0}
 
+    # Squeezing via the factor: the 16-row expert-grid minimum
+    # (moe_mlp._round16) floors expert capacity, so the overflow must come
+    # from the DISPATCH capacity — a longer prompt pushes enough (token, k)
+    # pairs at one rank to overflow its _round8'd dispatch block.
     tight = Engine(ModelConfig.from_name("tiny-moe",
                                          moe_capacity_factor=0.25),
                    mesh=mesh4, mode="dist", key=jax.random.PRNGKey(7),
                    params=roomy.params, block_n=8)
-    stats = tight.moe_drop_stats(prompt)
+    long_prompt = jnp.asarray(
+        np.arange(WORLD * 16).reshape(WORLD, 16) % 128, jnp.int32)
+    stats = tight.moe_drop_stats(long_prompt)
     assert stats["n_dropped_dispatch"] + stats["n_dropped_expert"] > 0
 
     dense = Engine(ModelConfig.from_name("tiny"), mesh=mesh4, mode="dist",

@@ -44,6 +44,13 @@ def _round8(x: int) -> int:
     return max(8, (int(x) + 7) // 8 * 8)
 
 
+def _round16(x: int) -> int:
+    """Expert-grid row granularity: 16-row minimum so the grouped GEMM's
+    bf16 operands never drop below Mosaic's packed-tile sublane count (an
+    8-row decode grid measured 2x slower through relayouts)."""
+    return max(16, (int(x) + 15) // 16 * 16)
+
+
 @dataclasses.dataclass(frozen=True)
 class MoEMLP:
     """Sparse gated-SwiGLU FFN with top-k routing."""
@@ -107,22 +114,35 @@ class MoEMLP:
             w = w / jnp.sum(w, axis=-1, keepdims=True)
         return w, ids.astype(jnp.int32)
 
-    def _expert_ffn(self, grouped, w_gate_up, w_down):
+    def _expert_ffn(self, grouped, w_gate_up, w_down, counts=None,
+                    interpret=None):
         """Gated SwiGLU over a (E_local, cap, d) capacity grid (empty slots
-        are zero and stay zero through the gate)."""
-        h = moe_utils.grouped_gemm(grouped, w_gate_up)
+        are zero and stay zero through the gate). With ``counts`` (the
+        dispatch's per-expert arrival counts) the GEMMs run the count-aware
+        Pallas kernel that skips empty experts' weight fetches
+        (``moe_utils.grouped_gemm_skip`` — decisive at decode batches where
+        most experts are empty); without counts (the XLA golden path's
+        worst-case grid) the plain batched einsum."""
+        if counts is None:
+            h = moe_utils.grouped_gemm(grouped, w_gate_up)
+        else:
+            h = moe_utils.grouped_gemm_skip(grouped, w_gate_up, counts,
+                                            interpret=interpret)
         ff = h.shape[-1] // 2
         act = (jax.nn.silu(h[..., :ff].astype(jnp.float32))
                * h[..., ff:].astype(jnp.float32)).astype(h.dtype)
-        return moe_utils.grouped_gemm(act, w_down)
+        if counts is None:
+            return moe_utils.grouped_gemm(act, w_down)
+        return moe_utils.grouped_gemm_skip(act, w_down, counts,
+                                           interpret=interpret)
 
     def _ep_layer(self, n_local_tokens: int, world: int) -> EPAll2AllLayer:
         pairs = n_local_tokens * self.topk
         cap = self.capacity or min(
             _round8(pairs * self.capacity_factor / world), _round8(pairs))
         ecap = self.expert_capacity or min(
-            _round8(world * pairs * self.capacity_factor / self.n_experts),
-            _round8(world * cap))
+            _round16(world * pairs * self.capacity_factor / self.n_experts),
+            _round16(world * cap))
         return EPAll2AllLayer(
             n_experts=self.n_experts, topk=self.topk, hidden=self.d_model,
             capacity=cap, expert_capacity=ecap, axis=self.axis)
@@ -130,10 +150,17 @@ class MoEMLP:
     # -- per-device forwards (inside shard_map) -----------------------------
 
     def dist_fwd(self, params, x_local, *, return_stats: bool = False,
-                 interpret=None):
+                 skip_gemm: bool = True, interpret=None):
         """x_local: (n_local, d) M-shard -> (n_local, d) M-shard. Routing is
         local (replicated router); the (token, k) pairs ride the
         single-kernel a2a to their experts' owners and back.
+
+        ``skip_gemm=False`` forces the einsum expert GEMM: under a
+        ``lax.scan`` over layers (the model body) the per-layer weight
+        slice must MATERIALIZE to feed a Pallas custom call — a 1.2 GB
+        copy per layer at 30b-a3b shapes that XLA fuses away for the
+        einsum (measured: the skip kernel e2e-decoded 2x SLOWER under the
+        scan despite winning 2.2x standalone at half occupancy).
 
         ``return_stats=True`` additionally returns the dispatch drop
         counters (``n_dropped_dispatch`` / ``n_dropped_expert`` int32
@@ -146,9 +173,12 @@ class MoEMLP:
         world = jax.lax.axis_size(self.axis)
         w, ids = self.route(params["router"], x_local)
         ep = self._ep_layer(x_local.shape[0], world)
-        grouped, _, state = ep.dispatch(x_local, ids, w, interpret=interpret)
+        grouped, expert_counts, state = ep.dispatch(x_local, ids, w,
+                                                    interpret=interpret)
         out = self._expert_ffn(grouped, params["w_gate_up"],
-                               params["w_down"])
+                               params["w_down"],
+                               counts=expert_counts if skip_gemm else None,
+                               interpret=interpret)
         y = ep.combine(out, state, interpret=interpret).astype(x_local.dtype)
         if return_stats:
             return y, state["stats"]

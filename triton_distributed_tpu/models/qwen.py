@@ -125,8 +125,12 @@ class Qwen3:
                 return jnp.ones(shape, jnp.float32)
 
             def randw(k, shape, fan_in):
-                return (jax.random.normal(k, shape) * fan_in ** -0.5
-                        ).astype(c.dtype)
+                # Sampled directly in the weight dtype: an fp32 intermediate
+                # doubles the transient next to the bf16 leaf (the
+                # depth-scaled 30b-a3b bench config's w_gate_up leaf alone
+                # would carry a ~10 GB fp32 transient on the 16 GB chip).
+                return (jax.random.normal(k, shape, c.dtype)
+                        * jnp.asarray(fan_in ** -0.5, c.dtype))
 
             wq = randw(next(ks), (L, d, c.n_heads * dh), d)
             wk = randw(next(ks), (L, d, c.n_kv_heads * dh), d)
@@ -326,12 +330,19 @@ class Qwen3:
             flat = hn.reshape(-1, c.d_model)
             stats = None
             if mode == "dist":
+                # MoE under the layer scan: force the einsum expert GEMM —
+                # a Pallas grouped GEMM would materialize each layer's
+                # scan-sliced weight stack as a custom-call operand (1.2 GB
+                # per layer at 30b-a3b; measured 2x slower e2e), while XLA
+                # fuses the slice into the einsum's reads.
+                kw = ({"skip_gemm": False} if c.n_experts else {})
                 if return_moe_stats:
                     m, stats = mlp.dist_fwd(lp["mlp"], flat,
                                             return_stats=True,
-                                            interpret=interpret)
+                                            interpret=interpret, **kw)
                 else:
-                    m = mlp.dist_fwd(lp["mlp"], flat, interpret=interpret)
+                    m = mlp.dist_fwd(lp["mlp"], flat, interpret=interpret,
+                                     **kw)
             elif mode == "xla":
                 m = mlp.xla_fwd(lp["mlp"], flat)
             else:
