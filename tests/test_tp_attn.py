@@ -140,3 +140,44 @@ def test_pack_unpack_roundtrip(mesh8):
     np.testing.assert_array_equal(np.asarray(uq), np.asarray(wq))
     np.testing.assert_array_equal(np.asarray(uk), np.asarray(wk))
     np.testing.assert_array_equal(np.asarray(uv), np.asarray(wv))
+
+
+def test_dist_fwd_varlen_prefill(mesh8, layer_and_io):
+    """Layer-level varlen (seq_lens plumbed through nn.attn_with_cache):
+    causality means a valid row's output is independent of the padded tail,
+    so each row's first seq_lens[b] outputs must equal the plain run, and
+    padding rows must come back zero from the attention."""
+    layer, params, x = layer_and_io
+    lens = np.array([4, 2, 1, 4, 3, 2, 4, 1], np.int32)
+
+    def f(params, xl, kc, vc, seq_lens):
+        return layer.dist_fwd(params, xl, kc, vc, jnp.int32(0),
+                              seq_lens=seq_lens)
+
+    specs = layer.param_specs()
+    fn = jax.jit(jax.shard_map(
+        f,
+        mesh=mesh8,
+        in_specs=(specs, P("tp"), P(None, None, "tp"), P(None, None, "tp"),
+                  P()),
+        out_specs=(P("tp"), P(None, None, "tp"), P(None, None, "tp")),
+        check_vma=False,
+    ))
+    kc, vc = _empty_cache()
+    got, _, _ = fn(params, x, kc, vc, jnp.asarray(lens))
+    want, _, _ = _run(layer, params, x, mesh8, "dist")
+    for b in range(B):
+        n = int(lens[b])
+        assert_allclose(np.asarray(got[b, :n]), np.asarray(want[b, :n]),
+                        atol=2e-3, rtol=2e-3)
+
+    # Padding rows: the attention emits zeros for them, so the layer output
+    # reduces to the o_proj of zeros = zeros -> got rows must differ from
+    # the plain run wherever that run attended real keys, and the
+    # attention-zero contract is visible as got == 0 through the residual-
+    # free layer (dist_fwd has no residual; o_proj(0) == 0).
+    for b in range(B):
+        n = int(lens[b])
+        if n < L:
+            np.testing.assert_allclose(np.asarray(got[b, n:]), 0.0,
+                                       atol=1e-6)

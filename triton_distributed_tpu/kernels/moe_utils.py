@@ -31,37 +31,47 @@ class RoutingPlan:
     (the role of the reference's gather/scatter index arrays). A pytree, so
     it crosses jit/shard_map boundaries between dispatch and combine."""
 
-    order: jax.Array        # (n*k,) flat-token permutation, sorted by dest
-    dest: jax.Array         # (n*k,) destination rank per sorted flat token
+    dest: jax.Array         # (n*k,) destination rank per flat (token, k)
     slot: jax.Array         # (n*k,) position within the dest capacity block
     counts: jax.Array       # (world,) tokens per destination rank
     kept: jax.Array         # (n*k,) bool: False where capacity overflowed
-    expert: jax.Array       # (n*k,) global expert id per sorted flat token
-    topk_weight: jax.Array  # (n*k,) routing weight per sorted flat token
+    expert: jax.Array       # (n*k,) global expert id per flat (token, k)
+    topk_weight: jax.Array  # (n*k,) routing weight per flat (token, k)
     n_dropped: jax.Array    # () int32: (token, k) pairs lost to capacity
 
 
 def sort_to_capacity(keys, n_buckets: int, capacity: int):
     """Shared core of every routing path (the role of the reference's CUDA
-    alignment op): stable-sort flat bucket keys, assign each element a slot
-    within its bucket's capacity block. Keys >= ``n_buckets`` sort to the
-    tail and are never kept.
+    alignment op): assign each flat bucket key a slot within its bucket's
+    capacity block, in stable (original) order. Keys >= ``n_buckets`` are
+    never kept.
 
-    Returns (order, keys_sorted, slot, kept, counts, n_dropped): ``counts``
+    SORT-FREE (round 5): the original form stable-argsorted the keys and
+    derived slots from bucket starts — but nothing downstream needs the
+    permutation, only the element-wise (key, slot, kept) assignment, and
+    slots-in-original-order are exactly a one-hot exclusive prefix sum:
+    ``slot[i] = #{j < i : keys[j] == keys[i]}``. The (n, n_buckets)
+    one-hot cumsum vectorizes on the VPU where XLA's TPU sort runs
+    log^2(n) compare-exchange passes; slot values are IDENTICAL to the
+    stable-sort form, so results are bitwise unchanged — and every
+    identity-permutation gather/scatter the sorted form needed downstream
+    disappears with it.
+
+    Returns (keys, slot, kept, counts, n_dropped): ``counts``
     clamped to capacity; ``n_dropped`` counts in-range keys lost to
     overflow (observable, never silent — ADVICE r1)."""
-    order = jnp.argsort(keys, stable=True)
-    keys_sorted = keys[order]
-    counts = jnp.bincount(keys_sorted, length=n_buckets + 1)[:n_buckets]
-    starts = jnp.concatenate([jnp.zeros((1,), counts.dtype),
-                              jnp.cumsum(counts)[:-1]])
-    slot = jnp.arange(keys_sorted.shape[0]) - starts[
-        jnp.clip(keys_sorted, 0, n_buckets - 1)]
-    in_range = keys_sorted < n_buckets
+    in_range = keys < n_buckets
+    k_safe = jnp.where(in_range, keys, 0)
+    onehot = ((k_safe[:, None] == jnp.arange(n_buckets)[None, :])
+              & in_range[:, None]).astype(jnp.int32)
+    ends = jnp.cumsum(onehot, axis=0)              # inclusive prefix count
+    # ends[i, keys[i]] - 1, picked without a per-row gather (elementwise
+    # mask-sum vectorizes; take_along_axis would scalar-gather per row).
+    slot = jnp.sum(ends * onehot, axis=1) - 1
+    counts = ends[-1]
     kept = in_range & (slot < capacity)
     n_dropped = jnp.sum(in_range & ~kept).astype(jnp.int32)
-    return (order, keys_sorted, slot, kept,
-            jnp.minimum(counts, capacity), n_dropped)
+    return keys, slot, kept, jnp.minimum(counts, capacity), n_dropped
 
 
 def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
@@ -80,13 +90,12 @@ def route_to_ranks(topk_ids, topk_weights, *, n_experts: int, world: int,
     flat_expert = topk_ids.reshape(-1)
     flat_weight = topk_weights.reshape(-1)
     dest = flat_expert // epr
-    order, dest_sorted, slot, kept, counts, n_dropped = sort_to_capacity(
+    _, slot, kept, counts, n_dropped = sort_to_capacity(
         dest, world, capacity)
-    return RoutingPlan(order=order, dest=dest_sorted,
-                       slot=jnp.where(kept, slot, 0),
+    return RoutingPlan(dest=dest, slot=jnp.where(kept, slot, 0),
                        counts=counts, kept=kept,
-                       expert=flat_expert[order],
-                       topk_weight=flat_weight[order],
+                       expert=flat_expert,
+                       topk_weight=flat_weight,
                        n_dropped=n_dropped)
 
 
@@ -116,8 +125,8 @@ def scatter_to_capacity(x, plan: RoutingPlan, *, world: int, capacity: int):
     """Pack per-token rows into the (world, capacity, hidden) send layout
     plus per-slot expert ids (world, capacity, 1) int32; invalid slots hold
     expert id -1."""
-    k_dup = plan.order.shape[0] // x.shape[0]
-    flat = jnp.repeat(x, k_dup, axis=0)[plan.order]
+    k_dup = plan.dest.shape[0] // x.shape[0]
+    flat = jnp.repeat(x, k_dup, axis=0)
     send_flat, inv = fill_by_inverse(
         flat, plan.dest * capacity + plan.slot, plan.kept, world * capacity)
     send = send_flat.reshape(world, capacity, x.shape[-1])
@@ -135,14 +144,10 @@ def gather_from_capacity(recv, plan: RoutingPlan, *, n_tokens: int):
     rows = recv[plan.dest, plan.slot]                      # (n*k, hidden)
     rows = jnp.where(plan.kept[:, None], rows, 0)
     rows = rows * plan.topk_weight[:, None].astype(rows.dtype)
-    # Un-sort by the INVERSE permutation (a scalar scatter) + row gather —
-    # never a row scatter (see fill_by_inverse).
-    nk = plan.order.shape[0]
-    inv_perm = jnp.zeros((nk,), jnp.int32).at[plan.order].set(
-        jnp.arange(nk, dtype=jnp.int32))
-    unsorted = rows[inv_perm]
-    k_dup = nk // n_tokens
-    return unsorted.reshape(n_tokens, k_dup, -1).sum(axis=1)
+    # Plan arrays are in flat (token, k) order (sort-free routing), so the
+    # k-duplicate reduction needs no un-permute.
+    k_dup = plan.dest.shape[0] // n_tokens
+    return rows.reshape(n_tokens, k_dup, -1).sum(axis=1)
 
 
 def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
@@ -162,16 +167,14 @@ def tokens_by_local_expert(recv_tokens, recv_ids, recv_counts, *,
     valid = (jnp.arange(world * cap) % cap) < jnp.repeat(recv_counts, cap)
     # Invalid tokens key to the tail bucket (n_local_experts) -> never kept.
     local = jnp.where(valid & (ids >= 0), ids - expert_base, n_local_experts)
-    order, local_sorted, slot, kept, counts, n_dropped = sort_to_capacity(
+    _, slot, kept, counts, n_dropped = sort_to_capacity(
         local, n_local_experts, expert_capacity)
-    # One composed gather: grid slot -> sorted position (inverse scatter of
-    # scalars) -> recv row. Empty slots read the appended zero row.
+    # Inverse scatter of scalars: grid slot -> flat recv row (sort-free
+    # routing keys the slots directly on flat indices). Empty slots read
+    # the appended zero row.
     n_flat = world * cap
-    inv = inverse_index(local_sorted * expert_capacity + slot, kept,
+    src = inverse_index(local * expert_capacity + slot, kept,
                         n_local_experts * expert_capacity, n_flat)
-    order_z = jnp.concatenate(
-        [order.astype(jnp.int32), jnp.full((1,), n_flat, jnp.int32)])
-    src = order_z[inv]                      # flat recv index, n_flat = empty
     flat_z = jnp.concatenate([flat, jnp.zeros((1, hidden), flat.dtype)])
     grouped = flat_z[src].reshape(n_local_experts, expert_capacity, hidden)
     src_flat_idx = jnp.where(src == n_flat, -1, src).reshape(
@@ -201,17 +204,14 @@ def route_to_experts(x, topk_ids, *, n_experts: int, capacity: int):
     slots zero, slot (n, k) — each pair's slot in its expert's block,
     kept (n, k) bool, n_dropped () int32)."""
     n, k = topk_ids.shape
-    order, sorted_e, slot_sorted, kept_sorted, _, n_dropped = (
-        sort_to_capacity(topk_ids.reshape(-1), n_experts, capacity))
-    rows = jnp.repeat(x, k, axis=0)[order]
+    flat_e = topk_ids.reshape(-1)
+    _, slot, kept, _, n_dropped = sort_to_capacity(
+        flat_e, n_experts, capacity)
+    rows = jnp.repeat(x, k, axis=0)
     grid_flat, _ = fill_by_inverse(
-        rows, sorted_e * capacity + slot_sorted, kept_sorted,
-        n_experts * capacity)
+        rows, flat_e * capacity + slot, kept, n_experts * capacity)
     grid = grid_flat.reshape(n_experts, capacity, x.shape[-1])
-    # Un-sort the (slot, kept) bookkeeping back to (n, k) order.
-    slot = jnp.zeros((n * k,), jnp.int32).at[order].set(
-        slot_sorted.astype(jnp.int32))
-    kept = jnp.zeros((n * k,), bool).at[order].set(kept_sorted)
+    slot = slot.astype(jnp.int32)
     return grid, slot.reshape(n, k), kept.reshape(n, k), n_dropped
 
 

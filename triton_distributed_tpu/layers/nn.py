@@ -90,7 +90,8 @@ def apply_rope(x, cos, sin):
 
 
 def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
-                    use_flash_decode: bool = True, interpret=None):
+                    use_flash_decode: bool = True, seq_lens=None,
+                    interpret=None):
     """GQA attention of new queries against a static-length KV cache.
 
     The jit-friendly decode/prefill attention (the analog of the reference's
@@ -107,15 +108,25 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     q:            (B, L, Hq, dh)   new queries (rope'd)
     k/v_cache:    (B, S, Hkv, dh)  already contain the new keys/values
     offset:       ()               int32 — cache length BEFORE this call
+    seq_lens:     (B,) int32 or None — varlen prefill (cu_seqlens-style,
+                  see kernels/sp_attention.flash_prefill): row b's valid
+                  queries/keys are its first seq_lens[b] positions after
+                  ``offset``; padding rows return zeros. L > 1 only.
     -> (B, L, Hq, dh) in q.dtype
     """
     B, L, Hq, dh = q.shape
+    if seq_lens is not None and L == 1:
+        # Contract check BEFORE the flash-decode gate: the kernel would
+        # silently ignore seq_lens and attend the whole cache.
+        raise ValueError("seq_lens is a varlen-PREFILL feature (L > 1)")
     # Flash decode earns its keep at LONG caches (streams KV, never
-    # materializes scores); at short caches the per-(batch, chunk) grid
-    # overhead loses to the fused dense path (measured on v5e, B=8
-    # Hkv=8 dh=128 28-layer stack: S=512 flash 3.84 ms vs dense 1.1 ms;
-    # the bench's 16k-context arm shows flash at ~60% of HBM peak where
-    # dense would materialize a 0.5 GB score tensor).
+    # materializes scores); at short caches the fused dense path ties or
+    # edges it (re-measured round 5 with the block-diagonal kernel, v5e
+    # B=8 Hkv=8 dh=128 28-layer stack at S=512: dense 0.675 ms vs flash
+    # 0.693, both near the 0.574 KV-read floor — the gate keeps dense for
+    # its fusability with surrounding ops). The bench's 16k-context arm
+    # shows the flash kernel at ~93% of HBM peak where dense would
+    # materialize a 0.5 GB score tensor.
     if L == 1 and use_flash_decode and k_cache.shape[1] >= 4096:
         from triton_distributed_tpu.kernels.sp_attention import (
             flash_decode_local,
@@ -132,7 +143,9 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
         from triton_distributed_tpu.kernels.sp_attention import flash_prefill
 
         out = flash_prefill(q, k_cache, v_cache, offset=offset,
-                            kv_len=offset + L, scale=scale,
+                            kv_len=None if seq_lens is not None else
+                            offset + L,
+                            seq_lens=seq_lens, scale=scale,
                             kv_layout="bshd", interpret=interpret)
         if out is not None:
             return out
@@ -153,7 +166,16 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     q_pos = offset + jnp.arange(L)                       # (L,)
     key_pos = jnp.arange(S)                              # (S,)
     mask = key_pos[None, :] <= q_pos[:, None]            # causal & in-cache
-    scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+    if seq_lens is not None:
+        # Per-row varlen: keys past offset+seq_lens[b] and query rows past
+        # seq_lens[b] are padding (same semantics as the flash kernel).
+        kv_lens = offset + seq_lens                      # (B,)
+        rowmask = (mask[None]
+                   & (key_pos[None, None, :] < kv_lens[:, None, None])
+                   & (jnp.arange(L)[None, :, None] < seq_lens[:, None, None]))
+        scores = jnp.where(rowmask[:, :, None, None, :], scores, _NEG_INF)
+    else:
+        scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
 
     p = jax.nn.softmax(scores, axis=-1)
     # DECODE fast path (use_flash_decode=True, L=1 fell back here):
@@ -169,6 +191,11 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
         p = p.astype(v_cache.dtype)
     out = jnp.einsum("blhgs,bshd->blhgd", p, v_cache,
                      preferred_element_type=jnp.float32)
+    if seq_lens is not None:
+        # Padding rows (all keys masked) would emit a uniform-softmax
+        # garbage average; match the flash kernel's contract: zeros.
+        valid_row = jnp.arange(L)[None, :] < seq_lens[:, None]      # (B, L)
+        out = jnp.where(valid_row[..., None, None, None], out, 0.0)
     return out.reshape(B, L, Hq, dh).astype(q.dtype)
 
 

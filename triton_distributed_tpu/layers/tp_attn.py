@@ -128,7 +128,8 @@ class TPAttn:
     # -- shared core --------------------------------------------------------
 
     def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world,
-                     use_flash_decode: bool = True, interpret=None):
+                     use_flash_decode: bool = True, seq_lens=None,
+                     interpret=None):
         """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
         output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
         cache-append -> GQA-attend pipeline shared by every mode
@@ -155,15 +156,16 @@ class TPAttn:
         out = nn.attn_with_cache(q, k_cache, v_cache, offset,
                                  scale=dh ** -0.5,
                                  use_flash_decode=use_flash_decode,
-                                 interpret=interpret)
+                                 seq_lens=seq_lens, interpret=interpret)
         return out.reshape(B, L, qs), k_cache, v_cache
 
     # -- per-device forwards (inside shard_map) -----------------------------
 
     def dist_fwd(self, params, x_local, k_cache, v_cache, offset, *,
-                 interpret=None):
+                 seq_lens=None, interpret=None):
         """x_local: (B_local, L, d) batch-shard -> same layout out.
-        AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203)."""
+        AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203).
+        ``seq_lens``: (B,) varlen prefill lengths (nn.attn_with_cache)."""
         world = jax.lax.axis_size(self.axis)
         Bl, L, d = x_local.shape
         qkv = ag_gemm_device(
@@ -171,7 +173,8 @@ class TPAttn:
             config=AGGEMMConfig(block_n=self.block_n), interpret=interpret)
         qkv = qkv.reshape(world * Bl, L, -1)
         out, k_cache, v_cache = self._qkv_to_attn(
-            params, qkv, k_cache, v_cache, offset, world, interpret=interpret)
+            params, qkv, k_cache, v_cache, offset, world, seq_lens=seq_lens,
+            interpret=interpret)
         out = gemm_rs_device(
             out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
             config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
