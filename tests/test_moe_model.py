@@ -97,11 +97,14 @@ def test_moe_mlp_drop_stats_surfaced(mesh4, rng):
     assert run(tight)["n_dropped_dispatch"] >= 32
 
 
-def test_grouped_gemm_skip_matches_einsum(rng):
+@pytest.mark.parametrize("stacked", [False, True])
+def test_grouped_gemm_skip_matches_einsum(rng, stacked):
     """The count-aware Pallas grouped GEMM (empty-expert weight-fetch skip)
     must match the einsum golden on the non-empty experts and return zeros
     for empty ones — including leading/trailing/consecutive empties (the
-    eff-index clamping cases)."""
+    eff-index clamping cases). The stacked form ((L, E, d, f) weights +
+    layer_idx selected in the kernel's index map — the scan-safe path)
+    must agree layer for layer."""
     from triton_distributed_tpu.kernels.moe_utils import (
         grouped_gemm,
         grouped_gemm_skip,
@@ -113,8 +116,20 @@ def test_grouped_gemm_skip_matches_einsum(rng):
     # Zero the slots beyond each expert's count (the grid contract).
     valid = jnp.arange(cap)[None, :] < counts[:, None]
     grouped = jnp.where(valid[..., None], grouped, 0)
+    if stacked:
+        L = 3
+        w_all = jnp.asarray(rng.standard_normal((L, E, d, f)), jnp.float32)
+        for li in range(L):
+            got = jax.jit(lambda g, w, c, li=li: grouped_gemm_skip(
+                g, w, c, layer_idx=jnp.int32(li),
+                interpret=True))(grouped, w_all, counts)
+            golden = grouped_gemm(grouped, w_all[li])
+            assert_allclose(got, jnp.where(valid[..., None], golden, 0),
+                            atol=1e-4, rtol=1e-4)
+        return
     w = jnp.asarray(rng.standard_normal((E, d, f)), jnp.float32)
-    got = jax.jit(lambda g, w, c: grouped_gemm_skip(g, w, c))(
+    got = jax.jit(lambda g, w, c: grouped_gemm_skip(g, w, c,
+                                                    interpret=True))(
         grouped, w, counts)
     golden = grouped_gemm(grouped, w)
     assert_allclose(got, jnp.where(valid[..., None], golden, 0), atol=1e-4,

@@ -241,7 +241,7 @@ def _grouped_gemm_skip_kernel(scal_ref, x_ref, w_ref, o_ref):
     @pl.when(scal_ref[e] > 0)
     def _compute():
         o_ref[0] = jax.lax.dot_general(
-            x_ref[0], w_ref[0], (((1,), (0,)), ((), ())),
+            x_ref[0], w_ref[0, 0], (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32).astype(o_ref.dtype)
 
     @pl.when(scal_ref[e] == 0)
@@ -252,8 +252,8 @@ def _grouped_gemm_skip_kernel(scal_ref, x_ref, w_ref, o_ref):
         o_ref[0] = jnp.zeros(o_ref.shape[1:], o_ref.dtype)
 
 
-def grouped_gemm_skip(grouped, weights, counts, *, block_n: int = 512,
-                      interpret=None):
+def grouped_gemm_skip(grouped, weights, counts, *, layer_idx=None,
+                      block_n: int = 512, interpret=None):
     """Count-aware Pallas grouped GEMM (the perf-grade expert GEMM of
     VERDICT r4 missing #1): ``(E, cap, d) x (E, d, f) -> (E, cap, f)``
     where experts with ``counts[e] == 0`` are SKIPPED — compute gated in
@@ -270,6 +270,14 @@ def grouped_gemm_skip(grouped, weights, counts, *, block_n: int = 512,
     dominant traffic; at large batches every expert is hit and the kernel
     degrades to einsum parity.
 
+    ``weights`` may be the FULL layer-STACKED array ``(L, E, d, f)`` with
+    ``layer_idx`` () int32 selecting the layer IN THE INDEX MAP — this is
+    how the kernel runs inside the model's ``lax.scan`` body: a scan-sliced
+    (E, d, f) operand would MATERIALIZE as a custom-call input (1.2 GB per
+    layer at 30b-a3b; XLA fuses the slice for an einsum but not for
+    Pallas), while block-indexing the stacked array fetches exactly the
+    blocks the non-empty experts need.
+
     Falls back to the einsum when the shapes don't tile (ragged f) — the
     kernel and the einsum are interchangeable by contract."""
     from jax.experimental.pallas import tpu as pltpu
@@ -277,20 +285,47 @@ def grouped_gemm_skip(grouped, weights, counts, *, block_n: int = 512,
     from triton_distributed_tpu.runtime.platform import resolve_interpret
 
     E, cap, d = grouped.shape
-    _, _, f = weights.shape
+    stacked = weights.ndim == 4
+    if stacked != (layer_idx is not None):
+        raise ValueError("layer_idx must be passed exactly when weights "
+                         "are layer-stacked (L, E, d, f)")
+    if not stacked:
+        # One code path: a plain (E, d, f) weight is the L=1 stacked case
+        # (free metadata reshape; layer scalar 0).
+        weights = weights[None]
+        layer_idx = 0
+    f = weights.shape[-1]
     bn = min(block_n, f)
     # cap < 16 falls back: sub-16-sublane bf16 operands hit Mosaic's
     # packed-tile relayout path (measured 2x SLOWER end-to-end at a cap=8
     # decode shape than the einsum despite the skip) — capacity sizing
     # keeps the EP grids at >= 16 rows (moe_mlp._ep_layer).
-    if f % bn or cap % 8 or (cap < 16 and grouped.dtype.itemsize < 4):
-        return grouped_gemm(grouped, weights)
+    from triton_distributed_tpu.runtime.platform import on_tpu
+
+    if (f % bn or cap % 8 or (cap < 16 and grouped.dtype.itemsize < 4)
+            or (interpret is None and not on_tpu())):
+        # The einsum fallback needs the layer slice; XLA fuses it into the
+        # einsum's reads (no copy) — and for non-stacked callers this is
+        # the free [0] of the [None] normalization above.
+        # AUTO-interpret (None off-TPU) also lands here: the faithful
+        # interpreter wedges executing this kernel's scalar-driven weight
+        # index maps inside a shard_map that carries an unrelated
+        # replicated mesh axis (observed: tiny-moe serve on a dp x tp
+        # virtual mesh never completes, while tp-only meshes and the
+        # direct unit test run fine). The einsum is the same math; kernel
+        # correctness stays covered by the EXPLICIT interpret=True unit
+        # test (test_grouped_gemm_skip_matches_einsum).
+        return grouped_gemm(grouped, weights[layer_idx])
     # Largest-index non-empty expert at-or-before e (leading empties clamp
     # to 0 — one harmless fetch of expert 0's weights).
     nonempty = counts > 0
     eff = jax.lax.cummax(
         jnp.where(nonempty, jnp.arange(E, dtype=jnp.int32), 0))
-    scalars = jnp.concatenate([counts.astype(jnp.int32), eff])
+    layer_scalar = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+    scalars = jnp.concatenate([counts.astype(jnp.int32), eff, layer_scalar])
+    w_spec = pl.BlockSpec(
+        (1, 1, d, bn),
+        lambda j, e, sc, E=E: (sc[2 * E], sc[E + e], 0, j))
     out = pl.pallas_call(
         _grouped_gemm_skip_kernel,
         out_shape=jax.ShapeDtypeStruct((E, cap, f), grouped.dtype),
@@ -306,8 +341,7 @@ def grouped_gemm_skip(grouped, weights, counts, *, block_n: int = 512,
                 # a non-empty expert has eff[e] == e (its own blocks).
                 pl.BlockSpec((1, cap, d),
                              lambda j, e, sc, E=E: (sc[E + e], 0, 0)),
-                pl.BlockSpec((1, d, bn),
-                             lambda j, e, sc, E=E: (sc[E + e], 0, j)),
+                w_spec,
             ],
             out_specs=pl.BlockSpec((1, cap, bn), lambda j, e, sc: (e, 0, j)),
             scratch_shapes=[],

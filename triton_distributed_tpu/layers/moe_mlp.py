@@ -115,18 +115,24 @@ class MoEMLP:
         return w, ids.astype(jnp.int32)
 
     def _expert_ffn(self, grouped, w_gate_up, w_down, counts=None,
-                    interpret=None):
+                    layer_idx=None, interpret=None):
         """Gated SwiGLU over a (E_local, cap, d) capacity grid (empty slots
         are zero and stay zero through the gate). With ``counts`` (the
         dispatch's per-expert arrival counts) the GEMMs run the count-aware
         Pallas kernel that skips empty experts' weight fetches
         (``moe_utils.grouped_gemm_skip`` — decisive at decode batches where
         most experts are empty); without counts (the XLA golden path's
-        worst-case grid) the plain batched einsum."""
+        worst-case grid) the plain batched einsum. ``layer_idx`` selects
+        the layer of layer-STACKED ``(L, E, ...)`` weights inside the
+        kernel's index maps — the scan-safe form (see dist_fwd)."""
         if counts is None:
+            if layer_idx is not None:
+                w_gate_up = w_gate_up[layer_idx]
+                w_down = w_down[layer_idx]
             h = moe_utils.grouped_gemm(grouped, w_gate_up)
         else:
             h = moe_utils.grouped_gemm_skip(grouped, w_gate_up, counts,
+                                            layer_idx=layer_idx,
                                             interpret=interpret)
         ff = h.shape[-1] // 2
         act = (jax.nn.silu(h[..., :ff].astype(jnp.float32))
@@ -134,6 +140,7 @@ class MoEMLP:
         if counts is None:
             return moe_utils.grouped_gemm(act, w_down)
         return moe_utils.grouped_gemm_skip(act, w_down, counts,
+                                           layer_idx=layer_idx,
                                            interpret=interpret)
 
     def _ep_layer(self, n_local_tokens: int, world: int) -> EPAll2AllLayer:
@@ -150,17 +157,19 @@ class MoEMLP:
     # -- per-device forwards (inside shard_map) -----------------------------
 
     def dist_fwd(self, params, x_local, *, return_stats: bool = False,
-                 skip_gemm: bool = True, interpret=None):
+                 skip_gemm: bool = True, layer_idx=None, interpret=None):
         """x_local: (n_local, d) M-shard -> (n_local, d) M-shard. Routing is
         local (replicated router); the (token, k) pairs ride the
         single-kernel a2a to their experts' owners and back.
 
-        ``skip_gemm=False`` forces the einsum expert GEMM: under a
-        ``lax.scan`` over layers (the model body) the per-layer weight
-        slice must MATERIALIZE to feed a Pallas custom call — a 1.2 GB
-        copy per layer at 30b-a3b shapes that XLA fuses away for the
-        einsum (measured: the skip kernel e2e-decoded 2x SLOWER under the
-        scan despite winning 2.2x standalone at half occupancy).
+        Under a ``lax.scan`` over layers (the model body) pass the FULL
+        layer-stacked ``w_gate_up``/``w_down`` ``(L, E, ...)`` plus
+        ``layer_idx``: a scan-SLICED (E, ...) weight operand must
+        MATERIALIZE to feed a Pallas custom call — a 1.2 GB copy per layer
+        at 30b-a3b that XLA fuses away for an einsum (measured 2x slower
+        e2e) — while the stacked form block-indexes the layer inside the
+        kernel and keeps the empty-expert fetch skip. ``skip_gemm=False``
+        forces the einsum expert GEMM (golden/debug).
 
         ``return_stats=True`` additionally returns the dispatch drop
         counters (``n_dropped_dispatch`` / ``n_dropped_expert`` int32
@@ -178,7 +187,7 @@ class MoEMLP:
         out = self._expert_ffn(grouped, params["w_gate_up"],
                                params["w_down"],
                                counts=expert_counts if skip_gemm else None,
-                               interpret=interpret)
+                               layer_idx=layer_idx, interpret=interpret)
         y = ep.combine(out, state, interpret=interpret).astype(x_local.dtype)
         if return_stats:
             return y, state["stats"]

@@ -312,8 +312,24 @@ class Qwen3:
                              "mode='dist' (drops only exist on the EP "
                              "dispatch path)")
 
+        # MoE dist mode: the heavy expert weights stay OUT of the scan's xs
+        # (closed over, full stacked (L, E, ...)) and the body passes a
+        # layer index instead — a scan-sliced (E, ...) weight operand would
+        # MATERIALIZE to feed the grouped-GEMM Pallas call (1.2 GB/layer at
+        # 30b-a3b; XLA fuses the slice for an einsum but not for a custom
+        # call), while the stacked form block-indexes the layer inside the
+        # kernel and keeps the empty-expert weight-fetch skip live e2e.
+        moe_dist = bool(c.n_experts) and mode == "dist"
+        scan_layers = dict(params["layers"])
+        moe_heavy = None
+        if moe_dist:
+            lp_mlp = dict(scan_layers["mlp"])
+            moe_heavy = {"w_gate_up": lp_mlp.pop("w_gate_up"),
+                         "w_down": lp_mlp.pop("w_down")}
+            scan_layers["mlp"] = lp_mlp
+
         def body(h, xs):
-            lp, kc, vc = xs
+            lp, kc, vc, li = xs
             resid = h
             hn = nn.rms_norm(h, lp["input_norm"], c.rms_eps)
             if mode == "dist":
@@ -330,18 +346,15 @@ class Qwen3:
             flat = hn.reshape(-1, c.d_model)
             stats = None
             if mode == "dist":
-                # MoE under the layer scan: force the einsum expert GEMM —
-                # a Pallas grouped GEMM would materialize each layer's
-                # scan-sliced weight stack as a custom-call operand (1.2 GB
-                # per layer at 30b-a3b; measured 2x slower e2e), while XLA
-                # fuses the slice into the einsum's reads.
-                kw = ({"skip_gemm": False} if c.n_experts else {})
+                mlp_params = (dict(lp["mlp"], **moe_heavy) if moe_dist
+                              else lp["mlp"])
+                kw = ({"layer_idx": li} if moe_dist else {})
                 if return_moe_stats:
-                    m, stats = mlp.dist_fwd(lp["mlp"], flat,
+                    m, stats = mlp.dist_fwd(mlp_params, flat,
                                             return_stats=True,
                                             interpret=interpret, **kw)
                 else:
-                    m = mlp.dist_fwd(lp["mlp"], flat, interpret=interpret,
+                    m = mlp.dist_fwd(mlp_params, flat, interpret=interpret,
                                      **kw)
             elif mode == "xla":
                 m = mlp.xla_fwd(lp["mlp"], flat)
@@ -352,14 +365,15 @@ class Qwen3:
                 return h, (kc, vc, stats)
             return h, (kc, vc)
 
+        layer_ids = jnp.arange(c.n_layers, dtype=jnp.int32)
         if return_moe_stats:
             h, (new_k, new_v, layer_stats) = jax.lax.scan(
-                body, h, (params["layers"], k_cache, v_cache))
+                body, h, (scan_layers, k_cache, v_cache, layer_ids))
             moe_stats = jax.tree.map(
                 lambda x: jax.lax.psum(jnp.sum(x), self.axis), layer_stats)
         else:
             h, (new_k, new_v) = jax.lax.scan(
-                body, h, (params["layers"], k_cache, v_cache))
+                body, h, (scan_layers, k_cache, v_cache, layer_ids))
 
         h = nn.rms_norm(h, params["final_norm"], c.rms_eps)
         last = h[:, -1]                                        # (*, d)
