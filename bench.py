@@ -428,6 +428,17 @@ def _run_benchmarks():
     moe_wbytes = (moe_params["w_gate_up"].nbytes
                   + moe_params["w_down"].nbytes)
     moe_floor_ms = moe_wbytes / _hbm_gbps() / 1e6
+    # The weights-only floor understates the op: the block MUST also move
+    # the routed activations (capacity grids in/out of the expert GEMMs,
+    # the h=2*ff intermediate, the combine gathers) — ~166 MB at this
+    # shape — and ~30 MB of routing index traffic. The traffic floor is
+    # the honest roofline; moe_block_hbm_frac keeps the weights-only
+    # denominator for round-over-round comparability.
+    E_, ecap_, d_, ffe_, pairs_ = 128, 64, 2048, 768, 512 * 8
+    moe_act_bytes = (2 * E_ * ecap_ * d_ * 2          # grid in + out
+                     + 2 * E_ * ecap_ * 2 * ffe_ * 2  # h write + read
+                     + 2 * pairs_ * d_ * 2)           # dispatch + combine rows
+    moe_traffic_floor_ms = (moe_wbytes + moe_act_bytes) / _hbm_gbps() / 1e6
 
     def body_moe(acc, x, p):
         xx = x + dep_scalar(acc).astype(x.dtype)
@@ -687,6 +698,9 @@ def _run_benchmarks():
             "flash_decode_hbm_frac": round(fd_floor_ms / fd_ms, 4),
             "moe_block_30b_a3b_ms": round(moe_ms, 4),
             "moe_block_hbm_frac": round(moe_floor_ms / moe_ms, 4),
+            "moe_block_traffic_floor_ms": round(moe_traffic_floor_ms, 4),
+            "moe_block_traffic_frac": round(moe_traffic_floor_ms / moe_ms,
+                                            4),
             "gemm_rs_smoke_shape_ms_xla_delegated": round(rs_ms, 4),
             "gemm_rs_smoke_shape_ms_padded_pallas": round(rs_pad_ms, 4),
             "ragged_k_best": "padded_pallas" if rs_pad_ms < rs_ms else "xla",
