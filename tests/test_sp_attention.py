@@ -352,6 +352,40 @@ def test_flash_prefill_varlen_matches_padded_golden(rng):
         np.testing.assert_array_equal(np.asarray(out[b, n:]), 0.0)
 
 
+def test_flash_prefill_varlen_with_offset(rng):
+    """Varlen chunked prefill against a cache that already holds ``offset``
+    earlier positions: row b's queries sit at [offset, offset+seq_lens[b])
+    and attend the first offset+seq_lens[b] cache keys."""
+    from triton_distributed_tpu.kernels.sp_attention import flash_prefill
+
+    B, L, Hq, Hkv, dh, S, off = 2, 16, 4, 2, 128, 64, 8
+    lens = np.array([16, 5], np.int32)
+    q = jnp.asarray(rng.standard_normal((B, L, Hq, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, S, Hkv, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, S, Hkv, dh)), jnp.float32)
+    out = flash_prefill(q, k, v, offset=off, seq_lens=jnp.asarray(lens),
+                        chunk=8)
+    assert out is not None
+    scale = dh ** -0.5
+    for b in range(B):
+        n = int(lens[b])
+        kvn = off + n
+        kx = np.repeat(np.moveaxis(np.asarray(k[b]), 1, 0), Hq // Hkv,
+                       axis=0)
+        vx = np.repeat(np.moveaxis(np.asarray(v[b]), 1, 0), Hq // Hkv,
+                       axis=0)
+        sc = np.einsum("lhd,hnd->hln", np.asarray(q[b, :n]),
+                       kx[:, :kvn]) * scale
+        qpos = off + np.arange(n)
+        mask = np.arange(kvn)[None, :] <= qpos[:, None]
+        sc = np.where(mask[None], sc, -1e30)
+        p = np.exp(sc - sc.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        gold = np.einsum("hln,hnd->lhd", p, vx[:, :kvn])
+        assert_allclose(out[b, :n], gold, atol=2e-3, rtol=2e-3)
+        np.testing.assert_array_equal(np.asarray(out[b, n:]), 0.0)
+
+
 def test_flash_prefill_falls_back_on_ragged_shapes(rng):
     from triton_distributed_tpu.kernels.sp_attention import flash_prefill
 
