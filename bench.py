@@ -39,6 +39,10 @@ Extras:
   model gets).
 - ``aot_step_*``: engine decode-step cold start, trace+compile vs
   serialized-executable deserialize (``AOTExecutableCache``).
+- ``serve_*``: the continuous-batching serving subsystem (serving/) under
+  a replayed Poisson arrival trace — TTFT p50/p95, generation tokens/s,
+  preemption count, and ``serve_retraces`` (must be 0: slot churn is data,
+  not shape).
 - ``qwen3_4b_*``: standalone-subprocess e2e decode (fresh HBM).
 
 Methodology (validated rounds 2-3; see tools/sweep_matmul.py): the axon TPU
@@ -58,6 +62,7 @@ import os
 import time
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 
 SHORT, LONG = 32, 96
@@ -112,7 +117,7 @@ def _moe_fwd_single(layer, params, x):
     a2a degenerate) — traceable inside the timing loop."""
     from jax.sharding import PartitionSpec as P
 
-    return jax.shard_map(
+    return shard_map(
         lambda p, xl: layer.dist_fwd(p, xl),
         mesh=_single_mesh(), in_specs=(layer.param_specs(), P("tp", None)),
         out_specs=P("tp", None), check_vma=False)(params, x)
@@ -434,10 +439,16 @@ def _run_benchmarks():
     # shape — and ~30 MB of routing index traffic. The traffic floor is
     # the honest roofline; moe_block_hbm_frac keeps the weights-only
     # denominator for round-over-round comparability.
-    E_, ecap_, d_, ffe_, pairs_ = 128, 64, 2048, 768, 512 * 8
-    moe_act_bytes = (2 * E_ * ecap_ * d_ * 2          # grid in + out
-                     + 2 * E_ * ecap_ * 2 * ffe_ * 2  # h write + read
-                     + 2 * pairs_ * d_ * 2)           # dispatch + combine rows
+    # Shapes derived from the live param arrays / layer config (not
+    # re-typed literals) so the floor tracks any shape change above.
+    E_, d_, ffe2_ = moe_params["w_gate_up"].shape
+    ffe_ = ffe2_ // 2
+    ecap_ = moe_layer.expert_capacity
+    pairs_ = xm.shape[0] * moe_layer.topk
+    itemsize_ = moe_params["w_gate_up"].dtype.itemsize
+    moe_act_bytes = (2 * E_ * ecap_ * d_ * itemsize_          # grid in + out
+                     + 2 * E_ * ecap_ * 2 * ffe_ * itemsize_  # h write + read
+                     + 2 * pairs_ * d_ * itemsize_)  # dispatch + combine rows
     moe_traffic_floor_ms = (moe_wbytes + moe_act_bytes) / _hbm_gbps() / 1e6
 
     def body_moe(acc, x, p):
@@ -668,6 +679,12 @@ def _run_benchmarks():
         e2e.update(_bench_e2e_subprocess("qwen3-30b-a3b-d6"))
     except Exception as e:  # noqa: BLE001
         e2e["qwen3_30b_a3b_d6_error"] = f"{type(e).__name__}: {str(e)[:120]}"
+    # Continuous-batching serving arm (serving/): scheduler + paged pool +
+    # fixed-shape batched step under a replayed Poisson arrival trace.
+    try:
+        e2e.update(_bench_serve())
+    except Exception as e:  # noqa: BLE001
+        e2e["serve_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
     print(json.dumps({
         "metric": "ag_gemm_loopback_m4096_qwen32b_tp8_ms",
@@ -776,6 +793,61 @@ def _bench_e2e_decode(model_name: str = "qwen3-1.7b", with_aot: bool = True):
 def _bench_tag(model_name: str) -> str:
     return (model_name.replace("qwen3-", "qwen3_").replace(".", "p")
             .replace("-", "_"))
+
+
+def _bench_serve(model_name: str = "qwen3-1.7b") -> dict:
+    """Continuous-batching serving arm: a fixed Poisson arrival trace
+    (open-loop, pre-drawn, so every run replays the same offered load)
+    through ``serving.BatchEngine`` — TTFT percentiles, generation
+    throughput, preemption count, and the one-compile guarantee under
+    real slot churn. Unlike the e2e decode slope this includes scheduler
+    and block-allocator host time, i.e. it is the serving-system number,
+    not the kernel number."""
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    config = ModelConfig.from_name(model_name, max_length=512)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="dist",
+                    key=jax.random.PRNGKey(0))
+    # Pool sized BELOW full residency so the arm also pays (and reports)
+    # eviction-by-recompute under load, like a saturated server would.
+    be = BatchEngine(engine, n_slots=8, n_blocks=8 * 10, block_size=16,
+                     prefill_chunk=64, max_seq_len=512)
+    rng = np.random.default_rng(0)
+    n_req, rate_hz = 24, 16.0
+    arrivals = np.cumsum(rng.exponential(1.0 / rate_hz, size=n_req))
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(32, 128))).tolist()
+               for _ in range(n_req)]
+    gens = rng.integers(16, 48, size=n_req)
+
+    t0 = time.perf_counter()
+    nxt = 0
+    while nxt < n_req or be.step():
+        now = time.perf_counter() - t0
+        while nxt < n_req and arrivals[nxt] <= now:
+            be.submit(prompts[nxt], max_new_tokens=int(gens[nxt]))
+            nxt += 1
+        if nxt < n_req and not be.step():
+            time.sleep(max(0.0, min(0.005, arrivals[nxt] - now)))
+    wall_s = time.perf_counter() - t0
+    m = be.metrics.as_dict()
+    be.pool.check_invariants()
+    return {
+        "serve_tokens_per_s": round(m["tokens_generated"] / wall_s, 1),
+        "serve_ttft_p50_ms": round(m["ttft_s_p50"] * 1e3, 2),
+        "serve_ttft_p95_ms": round(m["ttft_s_p95"] * 1e3, 2),
+        "serve_e2e_p95_ms": round(m["e2e_latency_s_p95"] * 1e3, 2),
+        "serve_preemptions": int(m.get("preemptions", 0)),
+        "serve_requests": int(m["requests_completed"]),
+        "serve_retraces": int(be.trace_counts["decode"]
+                              + be.trace_counts["prefill"] - 2),
+    }
 
 
 def _bench_e2e_subprocess(model_name: str) -> dict:

@@ -8,6 +8,7 @@ on *VMEM/input* buffers; keep each under 12KB).
 """
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -99,7 +100,7 @@ def test_world1_ragged_k_delegates_not_raises(rng):
     mesh1 = Mesh(np.array(jax.devices()[:1]), ("tp",))
 
     def run(fn):
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             fn, mesh=mesh1, in_specs=(P(None, None), P(None, None)),
             out_specs=P(None, None), check_vma=False))(a, b)
 
@@ -126,7 +127,7 @@ def test_ag_gemm_2d_vs_golden(rng):
         return ag_gemm_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
                                  config=AGGEMMConfig(block_n=128))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
         out_specs=P(None, ("dcn", "ici")),

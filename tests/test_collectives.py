@@ -12,6 +12,7 @@ from triton_distributed_tpu.kernels import (
     reduce_scatter,
 )
 from triton_distributed_tpu.runtime import assert_allclose
+from triton_distributed_tpu.runtime.compat import shard_map
 
 WORLD = 8
 
@@ -76,7 +77,7 @@ def test_one_shot_all_reduce_bitwise_identical_across_ranks(mesh8, rng):
     def f(xs):
         return oneshot_all_reduce(xs[0], axis="tp")[None]
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh8, in_specs=P("tp", None, None),
         out_specs=P("tp", None, None), check_vma=False))(x)
     ranks = np.asarray(out, dtype=np.float32)

@@ -4,6 +4,8 @@ test_ep_moe_inference.py, 8-way on the virtual CPU mesh (buffers sized under
 the conftest interpreter ceiling)."""
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -209,12 +211,12 @@ def test_ep_moe_layer_2d_vs_golden(rng):
     n_local = n_experts // W
 
     def f(x, ids_l, w, ew_all):
-        g = (jax.lax.axis_index("dcn") * jax.lax.axis_size("ep")
+        g = (jax.lax.axis_index("dcn") * _axis_size("ep")
              + jax.lax.axis_index("ep"))
         ew_local = jax.lax.dynamic_slice_in_dim(ew_all, g * n_local, n_local)
         return layer.moe_mlp(x[0], ids_l[0], w[0], ew_local)[None]
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(("dcn", "ep"), None, None),) * 3 + (P(),),
         out_specs=P(("dcn", "ep"), None, None),
@@ -255,7 +257,7 @@ def test_ep_moe_layer_vs_golden(mesh8, rng):
         ew_local = jax.lax.dynamic_slice_in_dim(ew_all, me * n_local, n_local)
         return layer.moe_mlp(x[0], ids[0], w[0], ew_local)[None]
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh8,
         in_specs=(P("tp", None, None), P("tp", None, None),
                   P("tp", None, None), P()),

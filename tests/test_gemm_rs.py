@@ -11,6 +11,7 @@ from triton_distributed_tpu.kernels.gemm_reduce_scatter import (
     gemm_rs,
 )
 from triton_distributed_tpu.runtime import assert_allclose
+from triton_distributed_tpu.runtime.compat import shard_map
 
 WORLD = 8
 
@@ -67,7 +68,7 @@ def test_gemm_rs_2d_vs_golden(rng):
         return gemm_rs_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
                                  config=GEMMRSConfig(block_n=128))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
         out_specs=P(("dcn", "ici"), None),

@@ -5,6 +5,7 @@ run 8-way on the virtual CPU mesh under the Pallas interpreter."""
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -41,7 +42,7 @@ def shard_run(kernel_fn, mesh, x, *, out_shape, scratch_shapes=(), collective_id
     in_spec = P("tp", *([None] * (x.ndim - 1)))
     out_spec = P("tp", *([None] * len(out_shape.shape)))
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             per_device, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
             check_vma=False,
         )

@@ -10,6 +10,7 @@ import numpy as np
 
 from triton_distributed_tpu.kernels import ll_all_gather, make_ll_staging
 from triton_distributed_tpu.runtime import assert_allclose
+from triton_distributed_tpu.runtime.compat import shard_map
 from triton_distributed_tpu.runtime.symm import clear_workspaces
 
 WORLD = 8
@@ -64,7 +65,7 @@ def test_flash_decode_rides_ll_allgather(mesh8, rng):
                                        ll_staging=stg[0], ll_epoch=ep)
         return out, stg[None]
 
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map(
         f, mesh=mesh8,
         in_specs=(P(), P(None, None, "tp", None), P(None, None, "tp", None),
                   P("tp"), P()),
@@ -101,7 +102,7 @@ def test_allgather_layer_dispatch(mesh8, rng):
         def f_dev(xs, method=method):
             return layer(xs[0], method=method)
 
-        out = jax.jit(jax.shard_map(
+        out = jax.jit(shard_map(
             f_dev, mesh=mesh8, in_specs=P("tp", None, None),
             out_specs=P(None, None), check_vma=False))(x)
         assert_allclose(out, np.asarray(x).reshape(WORLD * m, f))
@@ -111,7 +112,7 @@ def test_allgather_layer_dispatch(mesh8, rng):
         out, stg = layer(xs[0], staging=stg[0], epoch=ep)
         return out, stg[None]
 
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map(
         f_ll, mesh=mesh8,
         in_specs=(P("tp", None, None), P("tp"), P()),
         out_specs=(P(None, None), P("tp")),
@@ -150,7 +151,7 @@ def test_ll_all_gather_2d_multi_epoch(rng):
                                               dcn_axis="dcn")
             return out, sl[None]
 
-        return jax.shard_map(
+        return shard_map(
             f, mesh=mesh,
             in_specs=(P(("dcn", "ici")), P(("dcn", "ici")), P()),
             out_specs=(P(), P(("dcn", "ici"))),

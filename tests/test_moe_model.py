@@ -8,6 +8,7 @@ deadlocks on cross-device-blocking buffers >= 16KB (conftest ceiling).
 """
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -83,7 +84,7 @@ def test_moe_mlp_drop_stats_surfaced(mesh4, rng):
     x = jnp.asarray(rng.standard_normal((128, 32), dtype=np.float32))
 
     def run(layer):
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             lambda p, xl: layer.dist_fwd(p, xl, return_stats=True),
             mesh=mesh4, in_specs=(layer.param_specs(), P("tp", None)),
             out_specs=(P("tp", None), P()), check_vma=False))

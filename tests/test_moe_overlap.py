@@ -5,6 +5,8 @@ interpreter ceiling: the gathered-grid staging (world, E, cap, d) per device
 must stay under 12KB."""
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
@@ -51,7 +53,7 @@ def test_ag_moe_mlp_vs_golden(mesh8, rng):
                                            n_experts=E, capacity=cap)
         return out, n_dropped[None]
 
-    out, n_dropped = jax.jit(jax.shard_map(
+    out, n_dropped = jax.jit(shard_map(
         per_device, mesh=mesh8,
         in_specs=(P("tp", None), P("tp", None), P("tp", None), P(), P()),
         out_specs=(P("tp", None), P("tp")),
@@ -84,7 +86,7 @@ def test_ag_moe_mlp_2d_vs_golden(rng):
     w_down = rng.standard_normal((E, f, d), dtype=np.float32) * 0.2
 
     def per_device(x, ids_l, w_l, wu, wd):
-        g = (jax.lax.axis_index("dcn") * jax.lax.axis_size("ici")
+        g = (jax.lax.axis_index("dcn") * _axis_size("ici")
              + jax.lax.axis_index("ici"))
         wu_l = jax.lax.dynamic_slice(wu, (0, 0, g * f_local), (E, d, f_local))
         wd_l = jax.lax.dynamic_slice(wd, (0, g * f_local, 0), (E, f_local, d))
@@ -93,7 +95,7 @@ def test_ag_moe_mlp_2d_vs_golden(rng):
             ici_axis="ici", dcn_axis="dcn")
         return out, n_dropped[None]
 
-    out, n_dropped = jax.jit(jax.shard_map(
+    out, n_dropped = jax.jit(shard_map(
         per_device, mesh=mesh,
         in_specs=(P(("dcn", "ici"), None), P(("dcn", "ici"), None),
                   P(("dcn", "ici"), None), P(), P()),
@@ -125,7 +127,7 @@ def test_ag_group_gemm_layout_and_state(mesh8, rng):
                                          capacity=cap)
         return up, state["slot"], state["kept"]
 
-    up, slot, kept = jax.jit(jax.shard_map(
+    up, slot, kept = jax.jit(shard_map(
         per_device, mesh=mesh8,
         in_specs=(P("tp", None), P("tp", None), P()),
         out_specs=(P(None, None, "tp"), P("tp", None), P("tp", None)),

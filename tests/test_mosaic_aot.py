@@ -47,6 +47,7 @@ def _tpu_compile_supported(env) -> bool:
     return probe.returncode == 0
 
 
+@pytest.mark.slow  # full-registry Mosaic compile: far beyond the tier-1 budget
 def test_mosaic_aot_flagships():
     env = _clean_env()
     if not _tpu_compile_supported(env):

@@ -23,6 +23,7 @@ import os, sys
 sys.path.insert(0, '@REPO@')
 os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 jax.config.update("jax_platforms", "cpu")
 jax.distributed.initialize(coordinator_address='@COORD@',
                            num_processes=2,
@@ -81,7 +82,7 @@ elif scenario == "mesh":
     x = multihost_utils.host_local_array_to_global_array(
         jnp.asarray([[float(pid + 1)]]), mesh, P("dp"))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda xl: jax.lax.psum(xl, "dp"), mesh=mesh,
         in_specs=P("dp"), out_specs=P(), check_vma=False))(x)
     print("WINNER", float(out.addressable_data(0)[0, 0]), flush=True)

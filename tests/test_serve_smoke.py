@@ -1,0 +1,24 @@
+"""Tier-1 wiring for scripts/serve_smoke.py: a few seconds of synthetic
+Poisson load through the serving subsystem, failing on pool leaks, lost
+requests, or any step retrace beyond the first compile."""
+
+import importlib.util
+import pathlib
+
+_SCRIPT = pathlib.Path(__file__).parent.parent / "scripts" / "serve_smoke.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("serve_smoke", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serve_smoke_short():
+    m = _load().main(3.0, rate_hz=6.0, seed=0)
+    assert m["requests_submitted"] > 0
+    assert m["requests_completed"] == m["requests_submitted"]
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+    assert m["ttft_s_count"] == m["requests_submitted"]

@@ -5,6 +5,7 @@ Shapes honor the conftest interpreter ceiling (KV staging = world*H*m*dh*4B
 per buffer must stay under 16KB)."""
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -42,7 +43,7 @@ def test_sp_ag_attention_vs_dense(mesh8, rng, causal):
     def f(ql, kl, vl):
         return sp_ag_attention_device(ql, kl, vl, axis="tp", causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh8,
         in_specs=(P(None, "tp", None),) * 3,
         out_specs=P(None, "tp", None),
@@ -64,7 +65,7 @@ def test_flash_decode_vs_dense(mesh8, rng):
     def f(qf, kl, vl):
         return flash_decode_device(qf, kl, vl, axis="tp")
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh8,
         in_specs=(P(), P(None, None, "tp", None), P(None, None, "tp", None)),
         out_specs=P(),
@@ -177,7 +178,7 @@ def test_sp_gqa_decode_layer_kv_len(mesh8, rng):
     k = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
     v = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda qf, kl, vl: layer(qf, kl, vl, kv_len=kv_len),
         mesh=mesh8,
         in_specs=(P(), P(None, None, "tp", None), P(None, None, "tp", None)),
@@ -210,7 +211,7 @@ def test_sp_gqa_decode_layer_2d_kv_len(rng):
     k = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
     v = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda qf, kl, vl: layer(qf, kl, vl, kv_len=kv_len),
         mesh=mesh,
         in_specs=(P(), P(None, None, ("dcn", "sp"), None),
@@ -237,7 +238,7 @@ def test_sp_gqa_decode_layer(mesh8, rng):
     k = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
     v = rng.standard_normal((B, Hkv, S, dh), dtype=np.float32)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         lambda qf, kl, vl: layer(qf, kl, vl),
         mesh=mesh8,
         in_specs=(P(), P(None, None, "tp", None), P(None, None, "tp", None)),
@@ -415,7 +416,7 @@ def test_flash_decode_2d_vs_dense(rng):
         return flash_decode_2d_device(qr, kl, vl, ici_axis="sp",
                                       dcn_axis="dcn", kv_len=m_kv)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(), P(None, None, ("dcn", "sp"), None),
                   P(None, None, ("dcn", "sp"), None)),
@@ -457,7 +458,7 @@ def test_sp_ag_attention_2d_vs_dense(causal, rng):
         return sp_ag_attention_2d_device(ql, kl, vl, ici_axis="sp",
                                          dcn_axis="dcn", causal=causal)
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(P(None, ("dcn", "sp"), None),) * 3,
         out_specs=P(None, ("dcn", "sp"), None),

@@ -15,6 +15,8 @@ Shapes honor the conftest interpreter per-buffer ceiling (<=12KB).
 """
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -34,7 +36,7 @@ def _skew(x_local, axis="tp", scale=SKEW_STEPS):
 
 
 def _run8(f, mesh, in_specs, out_specs, *args):
-    return jax.jit(jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=in_specs,
                                  out_specs=out_specs, check_vma=False))(*args)
 
 
@@ -153,7 +155,7 @@ def test_stress_ll_allgather_epochs_with_stragglers(mesh8):
         out, stg = ll_all_gather_device(x, stg[0], ep, axis="tp")
         return out, stg[None]
 
-    run = jax.jit(jax.shard_map(
+    run = jax.jit(shard_map(
         f, mesh=mesh8,
         in_specs=(P("tp"), P("tp"), P()),
         out_specs=(P(), P("tp")),
@@ -187,7 +189,7 @@ def test_stress_2d_overlap_ops_with_stragglers():
     rng = np.random.default_rng(0)
 
     def skew2d(x):
-        g = (jax.lax.axis_index("dcn") * jax.lax.axis_size("ici")
+        g = (jax.lax.axis_index("dcn") * _axis_size("ici")
              + jax.lax.axis_index("ici"))
         return straggler_delay(x, g * SKEW_STEPS)
 
@@ -201,7 +203,7 @@ def test_stress_2d_overlap_ops_with_stragglers():
                                  dcn_axis="dcn",
                                  config=AGGEMMConfig(block_n=128))
 
-    out = jax.jit(jax.shard_map(
+    out = jax.jit(shard_map(
         f_ag, mesh=mesh,
         in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
         out_specs=P(None, ("dcn", "ici")), check_vma=False))(a, b)
@@ -219,7 +221,7 @@ def test_stress_2d_overlap_ops_with_stragglers():
                                  dcn_axis="dcn",
                                  config=GEMMRSConfig(block_n=128))
 
-    out2 = jax.jit(jax.shard_map(
+    out2 = jax.jit(shard_map(
         f_rs, mesh=mesh,
         in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
         out_specs=P(("dcn", "ici"), None), check_vma=False))(a2, b2)

@@ -6,6 +6,8 @@ conftest interpreter ceiling."""
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -86,14 +88,14 @@ def _run(layer, params, x, mesh, mode, offset=0, caches=None):
         # ar: replicated activations; gather in, slice out to match layout.
         x_full = jax.lax.all_gather(xl, layer.axis, axis=0, tiled=True)
         out, kc, vc = layer.ar_fwd(params, x_full, kc, vc, off)
-        world = jax.lax.axis_size(layer.axis)
+        world = _axis_size(layer.axis)
         me = jax.lax.axis_index(layer.axis)
         bl = out.shape[0] // world
         return (jax.lax.dynamic_slice_in_dim(out, me * bl, bl, axis=0),
                 kc, vc)
 
     specs = layer.param_specs()
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         f, mesh=mesh,
         in_specs=(specs, P("tp"), P(None, None, "tp"), P(None, None, "tp")),
         out_specs=(P("tp"), P(None, None, "tp"), P(None, None, "tp")),
@@ -155,7 +157,7 @@ def test_dist_fwd_varlen_prefill(mesh8, layer_and_io):
                               seq_lens=seq_lens)
 
     specs = layer.param_specs()
-    fn = jax.jit(jax.shard_map(
+    fn = jax.jit(shard_map(
         f,
         mesh=mesh8,
         in_specs=(specs, P("tp"), P(None, None, "tp"), P(None, None, "tp"),

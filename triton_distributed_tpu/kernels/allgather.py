@@ -35,6 +35,8 @@ import enum
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
@@ -144,7 +146,7 @@ def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
 
 
 def _ag_call(kernel, x_local, *, axis: str, interpret, collective_id: int):
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     if world == 1:
         return x_local
     m = x_local.shape[0]
@@ -226,7 +228,7 @@ def _build_ag(mesh, axis, method, interpret, nd):
         return per_device(xs[0], axis=axis, interpret=interpret)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=P(axis, *([None] * nd)),
             out_specs=P(*([None] * nd)),

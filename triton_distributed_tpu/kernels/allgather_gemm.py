@@ -38,6 +38,8 @@ import functools
 import math
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -261,9 +263,12 @@ def matmul_tail_into(c, a, b, col_start: int, *, block_n: int,
                 ) > _AUTO_VMEM_BUDGET:
             raise ValueError("tail blocks exceed the auto VMEM budget")
     except ValueError:
-        tail = jax.lax.slice_in_dim(
-            jnp.dot(a, b, preferred_element_type=jnp.float32),
-            col_start, n, axis=1).astype(out_dtype)
+        # Tail columns only: the overlap kernel already produced
+        # [0, col_start) in ``c`` — recomputing the full product just to
+        # slice it would redo col_start/n of the FLOPs for nothing.
+        tail = jnp.dot(
+            a, jax.lax.slice_in_dim(b, col_start, n, axis=1),
+            preferred_element_type=jnp.float32).astype(out_dtype)
         return jnp.concatenate([c, tail], axis=1)
     j0 = col_start // bn
     k_tiles = k // bk
@@ -366,7 +371,7 @@ def ag_gemm_device(a_local, b_local, *, axis: str = "tp",
     after the last segment signal (allgather_gemm.py:146); on TPU the tail
     is a second Pallas call so Mosaic pipelines it with full-size blocks."""
     config = config or AGGEMMConfig()
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     m, k = a_local.shape
     k2, n_local = b_local.shape
     if k != k2:
@@ -663,11 +668,11 @@ def ag_gemm_2d_device(a_local, b_local, *, ici_axis: str = "ici",
     calls)."""
     from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
 
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     if n_slices == 1:
         return ag_gemm_device(a_local, b_local, axis=ici_axis, config=config,
                               interpret=interpret)
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     m, k = a_local.shape
     n_local = b_local.shape[1]
     out_dtype = jnp.promote_types(a_local.dtype, b_local.dtype)
@@ -957,7 +962,7 @@ def _build_ag_gemm(mesh, axis, config, interpret):
                               interpret=interpret)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(P(axis, None), P(None, axis)),
             out_specs=P(None, axis),

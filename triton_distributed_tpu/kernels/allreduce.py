@@ -27,6 +27,8 @@ import enum
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -101,7 +103,7 @@ def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
 
 def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     """Latency-optimal allreduce of ``x_local (m, ...)`` along ``axis``."""
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     if world == 1:
         return x_local
     shape = x_local.shape
@@ -226,7 +228,7 @@ def _twoshot_ar_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
 def twoshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
     """Bandwidth-optimal allreduce (ring RS + ring AG fused in one kernel).
     Requires ``x_local.shape[0]`` divisible by world."""
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     if world == 1:
         return x_local
     if x_local.shape[0] % world:
@@ -295,7 +297,7 @@ def _build_ar(mesh, axis, method, interpret, nd):
         return per_device(xs[0], axis=axis, interpret=interpret)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=P(axis, *([None] * nd)),
             out_specs=P(*([None] * nd)),

@@ -30,6 +30,8 @@ from __future__ import annotations
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -56,7 +58,7 @@ def dcn_ring_walk(block_fn, combine, init, ringed, *, dcn_axis: str = "dcn"):
     ``combine(acc, cur, block)``. The permute of the next operands has no
     data dependence on the current block's compute, so XLA runs the DCN hop
     under it."""
-    n = jax.lax.axis_size(dcn_axis)
+    n = _axis_size(dcn_axis)
     sid = jax.lax.axis_index(dcn_axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     acc = init
@@ -81,7 +83,7 @@ def dcn_ring_reduce_scatter(part_fn, init, *, dcn_axis: str = "dcn"):
     ``init`` fixes the accumulator shape/dtype (use fp32). The next step's
     ``part_fn`` has no data dependence on the in-flight permute (only the
     cheap add joins them), so the DCN hop rides under the compute."""
-    n = jax.lax.axis_size(dcn_axis)
+    n = _axis_size(dcn_axis)
     sid = jax.lax.axis_index(dcn_axis)
     perm = [(i, (i + 1) % n) for i in range(n)]
     acc = init
@@ -117,8 +119,8 @@ def reduce_scatter_2d_device(x_local, *, ici_axis: str = "ici",
     ICI (Pallas), then ``psum_scatter`` the surviving ``w_dcn`` segments
     over DCN. Each ICI link carries each byte once; DCN carries only the
     already slice-reduced chunk."""
-    w_ici = jax.lax.axis_size(ici_axis)
-    w_dcn = jax.lax.axis_size(dcn_axis)
+    w_ici = _axis_size(ici_axis)
+    w_dcn = _axis_size(dcn_axis)
     rows = x_local.shape[0]
     if rows % (w_ici * w_dcn):
         raise ValueError(f"leading dim {rows} not divisible by world "
@@ -140,7 +142,7 @@ def all_reduce_2d_device(x_local, *, ici_axis: str = "ici",
     chunk over DCN (only 1/w_ici of the bytes cross the slow DCN hop), then
     ring-AG over ICI — the hierarchical two-shot (reference
     ``allreduce.py`` two-shot generalized to the 2D topology)."""
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     if x_local.shape[0] % w_ici:
         raise ValueError(
             f"2D allreduce needs leading dim {x_local.shape[0]} divisible by "
@@ -166,7 +168,7 @@ def _2d_wrapper(per_device, out_stacked: bool):
         rest = [None] * nd
         out_spec = (P((dcn_axis, ici_axis), *rest) if out_stacked
                     else P(*rest))
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             f, mesh=mesh,
             in_specs=P((dcn_axis, ici_axis), *rest),
             out_specs=out_spec,

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from triton_distributed_tpu.runtime import compat as _compat  # noqa: F401
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
 # ---------------------------------------------------------------------------
@@ -79,11 +80,17 @@ def cost_estimate(*, flops: int, bytes_accessed: int,
     annotations (allgather_gemm.py:132); shows up in XPlane traces
     (``group_profile``) and informs XLA's async scheduling around the
     kernel."""
+    import dataclasses
+
     from jax.experimental import pallas as pl
 
-    return pl.CostEstimate(flops=int(flops), transcendentals=0,
-                           bytes_accessed=int(bytes_accessed),
-                           remote_bytes_transferred=int(remote_bytes))
+    kw = dict(flops=int(flops), transcendentals=0,
+              bytes_accessed=int(bytes_accessed))
+    # old jax's CostEstimate predates the remote-bytes field
+    if "remote_bytes_transferred" in {f.name for f in
+                                      dataclasses.fields(pl.CostEstimate)}:
+        kw["remote_bytes_transferred"] = int(remote_bytes)
+    return pl.CostEstimate(**kw)
 
 
 def local_copy(src_ref, dst_ref, sem):

@@ -39,6 +39,8 @@ import dataclasses
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -186,7 +188,7 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
         raise ValueError(f"direction must be 'dispatch' or 'combine', got {direction!r}")
     single = not isinstance(payloads, (tuple, list))
     payloads = (payloads,) if single else tuple(payloads)
-    world = jax.lax.axis_size(ctx.axis)
+    world = _axis_size(ctx.axis)
     if world == 1:
         return (payloads[0] if single else payloads), send_counts
     for pay in payloads:
@@ -259,7 +261,7 @@ def _build_a2a(mesh, ctx, payload_ndims, interpret):
 
     pay_spec = tuple(P(ctx.axis, *([None] * (nd - 1))) for nd in payload_ndims)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(pay_spec, P(ctx.axis, None)),
             out_specs=(pay_spec, P(ctx.axis, None)),
@@ -388,14 +390,14 @@ def fast_all_to_all_2d(payloads, send_counts, *, ctx: AllToAllContext,
     Returns ``(recv_payloads, recv_counts)`` with slot p = from global
     peer p. Counts ride both hops, so receivers learn exact splits from
     the wire at every level."""
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     ctx_ici = dataclasses.replace(ctx, axis=ici_axis)
     if n_slices == 1:
         return fast_all_to_all(payloads, send_counts, ctx=ctx_ici,
                                direction=direction, interpret=interpret)
     single = not isinstance(payloads, (tuple, list))
     payloads = (payloads,) if single else tuple(payloads)
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     W = n_slices * w_ici
     for pay in payloads:
         if pay.shape[0] != W or pay.shape[1] != ctx.capacity:
@@ -453,7 +455,7 @@ def _build_a2a_2d(mesh, ctx, payload_ndims, ici_axis, dcn_axis, interpret):
     axes = (dcn_axis, ici_axis)
     pay_spec = tuple(P(axes, *([None] * (nd - 1))) for nd in payload_ndims)
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(pay_spec, P(axes, None)),
             out_specs=(pay_spec, P(axes, None)),

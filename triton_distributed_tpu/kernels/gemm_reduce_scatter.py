@@ -27,6 +27,8 @@ import dataclasses
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -160,7 +162,7 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
     ``(M, k_local) x (k_local, N) -> (m, N)`` — segment ``me`` of the
     reduce-scattered full product, comm overlapped into the matmul."""
     config = config or GEMMRSConfig()
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     M, k_local = a_local.shape
     _, n = b_local.shape
     if world == 1:
@@ -383,11 +385,11 @@ def gemm_rs_2d_device(a_local, b_local, *, ici_axis: str = "ici",
         dcn_ring_reduce_scatter,
     )
 
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     if n_slices == 1:
         return gemm_rs_device(a_local, b_local, axis=ici_axis, config=config,
                               interpret=interpret)
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     M, k_local = a_local.shape
     n = b_local.shape[1]
     if M % (n_slices * w_ici):
@@ -428,7 +430,7 @@ def _build_gemm_rs(mesh, axis, config, interpret):
                               interpret=interpret)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(P(None, axis), P(axis, None)),
             out_specs=P(axis, None),

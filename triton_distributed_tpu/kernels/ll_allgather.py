@@ -38,6 +38,8 @@ from __future__ import annotations
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -101,7 +103,7 @@ def ll_all_gather_device(x_local, staging, epoch, *, axis: str = "tp",
     the call counter driving slot parity. Returns (gathered (world*m, ...),
     staging) — thread the returned staging (same buffer, aliased) into the
     next call."""
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     if world == 1:
         return x_local, staging
     m = x_local.shape[0]
@@ -170,7 +172,7 @@ def _build_ll_ag(mesh, axis, interpret, nd):
 
     rest = [None] * nd
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(P(axis, *rest), P(axis), P()),
             out_specs=(P(*rest), P(axis)),
@@ -191,7 +193,7 @@ def ll_all_gather_2d_device(x_local, staging, epoch, *, ici_axis: str = "ici",
     exactly once, already aggregated (w_ici messages ride one DCN
     transfer). Output is in dcn-major global rank order. Returns
     (gathered (n_slices*w_ici*m, ...), staging)."""
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     intra, staging = ll_all_gather_device(x_local, staging, epoch,
                                           axis=ici_axis, interpret=interpret)
     if n_slices == 1:

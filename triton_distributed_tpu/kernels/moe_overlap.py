@@ -44,6 +44,7 @@ import dataclasses
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -150,7 +151,7 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     there directly), plus ``n_dropped``: capacity overflow is observable,
     never silent (ADVICE r1)."""
     config = config or MoEOverlapConfig()
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     m, d = x_local.shape
     E, _, f_local = w_up_local.shape
     if E != n_experts:
@@ -315,7 +316,7 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
     (reference ``moe_reduce_rs_rowise``, moe_reduce_rs.py:816), comm
     overlapped into the expert GEMMs."""
     config = config or MoEOverlapConfig()
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     E, rows, f_local = act.shape
     _, _, d = w_down_local.shape
     if rows != world * capacity:
@@ -426,13 +427,13 @@ def ag_group_gemm_2d_device(x_local, topk_ids_local, w_up_local, *,
     (up (E, n_slices*w_ici*cap, f_local), state-of-own-slice)."""
     from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
 
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     if n_slices == 1:
         return ag_group_gemm_device(
             x_local, topk_ids_local, w_up_local, n_experts=n_experts,
             capacity=capacity, axis=ici_axis, config=config,
             interpret=interpret)
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     E, _, f_local = w_up_local.shape
     out_dtype = jnp.promote_types(x_local.dtype, w_up_local.dtype)
     own_state = {}
@@ -471,12 +472,12 @@ def group_gemm_rs_2d_device(act, w_down_local, *, capacity: int,
         dcn_ring_reduce_scatter,
     )
 
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     if n_slices == 1:
         return group_gemm_rs_device(act, w_down_local, capacity=capacity,
                                     axis=ici_axis, config=config,
                                     interpret=interpret)
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     E, rows, f_local = act.shape
     d = w_down_local.shape[2]
     if rows != n_slices * w_ici * capacity:

@@ -17,7 +17,6 @@ layout (slot = local expert).
 from __future__ import annotations
 
 import dataclasses
-import functools
 
 import jax
 import jax.numpy as jnp
@@ -303,10 +302,13 @@ def grouped_gemm_skip(grouped, weights, counts, *, layer_idx=None,
     from triton_distributed_tpu.runtime.platform import on_tpu
 
     if (f % bn or cap % 8 or (cap < 16 and grouped.dtype.itemsize < 4)
-            or (interpret is None and not on_tpu())):
+            or (interpret is not True and not on_tpu())):
         # The einsum fallback needs the layer slice; XLA fuses it into the
         # einsum's reads (no copy) — and for non-stacked callers this is
         # the free [0] of the [None] normalization above.
+        # interpret=False off-TPU lands here too: "compiled" has no meaning
+        # without a TPU backend, and handing Mosaic a CPU target fails at
+        # lowering — the einsum is the same math either way.
         # AUTO-interpret (None off-TPU) also lands here: the faithful
         # interpreter wedges executing this kernel's scalar-driven weight
         # index maps inside a shard_map that carries an unrelated

@@ -27,6 +27,8 @@ from __future__ import annotations
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -123,7 +125,7 @@ def _ring_rs_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
 
 def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
              n_staging_key: str):
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     if world == 1:
         return x_local
     if x_local.shape[0] % world:
@@ -236,7 +238,7 @@ def _build_rs(mesh, axis, method, interpret, nd):
         return per_device(xs[0], axis=axis, interpret=interpret)[None]
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=P(axis, *([None] * nd)),
             out_specs=P(axis, *([None] * nd)),

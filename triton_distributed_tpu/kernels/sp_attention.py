@@ -30,6 +30,7 @@ from __future__ import annotations
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -157,7 +158,7 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
     ``me * m`` / 0). ``return_partials=True`` additionally returns the
     per-row log-sum-exp (H, m) — the mergeable-partial form consumed by the
     inter-slice ring (``sp_ag_attention_2d_device``)."""
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     H, m, dh = q_local.shape
     scale = dh ** -0.5 if scale is None else scale
     if world == 1 and not return_partials and row_offset is None \
@@ -244,7 +245,7 @@ def sp_ag_attention_2d_device(q_local, k_local, v_local, *,
     under intra-slice compute."""
     from triton_distributed_tpu.kernels.collective_2d import dcn_ring_walk
 
-    w_ici = jax.lax.axis_size(ici_axis)
+    w_ici = _axis_size(ici_axis)
     H, m, dh = q_local.shape
     m_kv = k_local.shape[1]
     scale = dh ** -0.5 if scale is None else scale
@@ -509,7 +510,7 @@ def _flash_decode_kernel(kvlen_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
     reference's split-KV kernel (flash_decode.py:130) with the chunk loop as
     the Pallas grid instead of persistent CTAs."""
     c = pl.program_id(1)
-    kv_len = kvlen_ref[0]
+    kv_len = kvlen_ref[pl.program_id(0)]   # per-row: serving's slot offsets
 
     @pl.when(c == 0)
     def _init():
@@ -572,7 +573,7 @@ def _flash_decode_bd_kernel(kvlen_ref, qbd_ref, k_ref, v_ref, o_ref, lse_ref,
     a mask-sum. Reference structure: flash_decode.py:130 split-KV with the
     chunk loop as the Pallas grid."""
     c = pl.program_id(1)
-    kv_len = kvlen_ref[0]
+    kv_len = kvlen_ref[pl.program_id(0)]   # per-row: serving's slot offsets
     rows = n_kv * g
 
     @pl.when(c == 0)
@@ -648,8 +649,9 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
     q: (B, Hq, dh); k/v_cache: (B, Hkv, m_kv, dh) — or (B, m_kv, Hkv, dh)
     with ``kv_layout="bshd"`` (the TP cache layout; the BlockSpec index map
     absorbs the layout, no transpose materializes). Hq % Hkv == 0 (GQA stays
-    native — no KV head expansion materializes). ``kv_len`` (int32 scalar)
-    masks cache positions >= it (preallocated-cache decode); None = full.
+    native — no KV head expansion materializes). ``kv_len`` (int32 scalar
+    or (B,) vector — the serving path's per-slot offsets) masks cache
+    positions >= it per row (preallocated-cache decode); None = full.
     Returns (out (B, Hq, dh) fp32, lse (B, Hq) fp32) — the split-KV partial
     pair the inter-rank combine merges (reference flash_decode.py:130/:482).
     """
@@ -670,8 +672,9 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
     per_pos = Hkv * dh * k_cache.dtype.itemsize * 4
     ck = _kv_chunk(m_kv, min(chunk, max(8, _DECODE_KV_BUDGET // per_pos)))
     n_chunks = m_kv // ck
-    kv_len = jnp.asarray(
-        m_kv if kv_len is None else kv_len, jnp.int32).reshape(1)
+    kv_len = jnp.broadcast_to(
+        jnp.asarray(m_kv if kv_len is None else kv_len,
+                    jnp.int32).reshape(-1), (B,))
 
     # Blocks span ALL local kv heads: Mosaic requires the last two block dims
     # be 8/128-divisible or equal to the full array dims; per-head blocks in
@@ -779,6 +782,42 @@ def flash_decode_local(q, k_cache, v_cache, *, kv_len=None,
     return out.reshape(B, Hq, dh), lse.reshape(B, Hq)
 
 
+# ---------------------------------------------------------------------------
+# Paged (block-table) KV access — the serving subsystem's cache layout
+# ---------------------------------------------------------------------------
+
+
+def paged_gather_kv(pool, block_tables, *, slot_mask=None):
+    """Gather one layer's block-paged KV pool into the contiguous per-slot
+    layout the attention paths consume (vLLM-style PagedAttention read).
+
+    pool: (n_blocks, block_size, Hkv, dh) — this device's kv-head shard of
+    one layer of ``serving.kv_pool.PagedKVState``. block_tables:
+    (B, max_blocks) int32 — slot b's sequence occupies blocks
+    ``block_tables[b, :ceil(len/block_size)]`` in order; tail entries are
+    allocator padding. Returns (B, max_blocks * block_size, Hkv, dh) — slot
+    b's tokens contiguous in sequence order, exactly the ``KVCache`` row
+    layout, so the flash/dense attention kernels run UNCHANGED on the
+    gathered view with per-slot ``kv_len`` masking the tail.
+
+    ``slot_mask`` (B,) bool routes inactive slots' reads at block 0: a
+    freed slot's stale table entries may point at blocks since reallocated
+    to other sequences — masked-out garbage either way (attention masks
+    positions >= the slot offset), but the mask keeps a dead slot from
+    touching live sequences' blocks at all.
+
+    Decode attention reads the whole valid cache regardless of layout, so
+    the gather adds no asymptotic HBM traffic over the contiguous path; a
+    fused in-kernel block walk (index-map over the table, skipping the
+    gather materialization) is the Pallas upgrade path.
+    """
+    B, nb = block_tables.shape
+    if slot_mask is not None:
+        block_tables = jnp.where(slot_mask[:, None], block_tables, 0)
+    g = jnp.take(pool, block_tables.reshape(-1), axis=0)   # clamp OOB
+    return g.reshape(B, nb * pool.shape[1], *pool.shape[2:])
+
+
 def decode_partial_feat(dh: int) -> int:
     """Feature width of the packed (out, lse) decode-partial rows exchanged
     between ranks: dh + 1 rounded up to a lane multiple (128) — callers
@@ -816,7 +855,7 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
     flash-decode with its LL protocol for exactly this exchange
     (sp_flash_decode_layer.py:83). Returns (out, staging) in that case.
     """
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     B, H, dh = q.shape
     out_local, lse_local = flash_decode_local(
         q, k_cache_local, v_cache_local, kv_len=kv_len, scale=scale,
@@ -870,7 +909,7 @@ def flash_decode_2d_device(q, k_cache_local, v_cache_local, *,
     by log-sum-exp over one DCN allgather of the tiny packed rows (decode
     partials are KB-scale — latency-bound, exactly what the DCN hop wants).
     """
-    n_slices = jax.lax.axis_size(dcn_axis)
+    n_slices = _axis_size(dcn_axis)
     if n_slices == 1:
         return flash_decode_device(q, k_cache_local, v_cache_local,
                                    axis=ici_axis, kv_len=kv_len, scale=scale,
@@ -879,7 +918,7 @@ def flash_decode_2d_device(q, k_cache_local, v_cache_local, *,
     # Intra-slice: local partial + ring exchange, but keep the SLICE partial
     # mergeable — recover (out_s, lse_s) for this slice by re-merging the
     # slice's rank partials with their LSEs.
-    world = jax.lax.axis_size(ici_axis)
+    world = _axis_size(ici_axis)
     out_local, lse_local = flash_decode_local(
         q, k_cache_local, v_cache_local, kv_len=kv_len, scale=scale,
         interpret=interpret)

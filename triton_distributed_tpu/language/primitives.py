@@ -30,6 +30,8 @@ semaphores only add; SET is emulated where needed at the buffer level.
 from __future__ import annotations
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import mesh_device_id as _mesh_device_id
 from jax.experimental.pallas import tpu as pltpu
 
 SIGNAL_SET = "set"
@@ -43,7 +45,7 @@ def rank(axis: str = "tp"):
 
 def num_ranks(axis: str = "tp"):
     """World size along a mesh axis (dl.num_ranks, distributed_ops.py:94)."""
-    return jax.lax.axis_size(axis)
+    return _axis_size(axis)
 
 
 def wait(sem_ref, value: int = 1, *, scope: str = "gpu", semantic: str = "acquire"):
@@ -84,7 +86,7 @@ def notify(sem_ref, peer=None, *, axis: str = "tp", inc: int = 1,
         pltpu.semaphore_signal(sem_ref, inc=inc)
     else:
         pltpu.semaphore_signal(
-            sem_ref, inc=inc, device_id={axis: peer},
+            sem_ref, inc=inc, device_id=_mesh_device_id(axis, peer),
             device_id_type=pltpu.DeviceIdType.MESH,
         )
 
@@ -103,14 +105,14 @@ def barrier_all(axis: str = "tp"):
     semaphore: every device signals every other device once, then waits for
     world-1 signals. Requires ``collective_id`` in CompilerParams.
     """
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     me = jax.lax.axis_index(axis)
     barrier_sem = pltpu.get_barrier_semaphore()
 
     def signal_peer(i, _):
         peer = jax.lax.rem(me + 1 + i, world)
         pltpu.semaphore_signal(
-            barrier_sem, inc=1, device_id={axis: peer},
+            barrier_sem, inc=1, device_id=_mesh_device_id(axis, peer),
             device_id_type=pltpu.DeviceIdType.MESH,
         )
         return _

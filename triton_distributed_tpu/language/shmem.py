@@ -24,6 +24,8 @@ Key semantic differences, by hardware design:
 from __future__ import annotations
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import mesh_device_id as _mesh_device_id
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.language.primitives import rank as my_pe  # noqa: F401
@@ -33,7 +35,7 @@ from triton_distributed_tpu.language.primitives import num_ranks as n_pes  # noq
 def remote_rank(offset: int | object, axis: str = "tp"):
     """Logical rank at ``(me + offset) % world`` — the ring-addressing helper
     used throughout the reference's ring kernels (allgather.py:81-140)."""
-    world = jax.lax.axis_size(axis)
+    world = _axis_size(axis)
     me = jax.lax.axis_index(axis)
     return jax.lax.rem(me + offset + world, world)
 
@@ -49,7 +51,7 @@ def putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, *, axis: str = "tp"):
         dst_ref=dst_ref,
         send_sem=send_sem,
         recv_sem=recv_sem,
-        device_id={axis: peer},
+        device_id=_mesh_device_id(axis, peer),
         device_id_type=pltpu.DeviceIdType.MESH,
     )
     dma.start()

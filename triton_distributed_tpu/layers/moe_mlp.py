@@ -32,6 +32,8 @@ import dataclasses
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -179,7 +181,7 @@ class MoEMLP:
         counters at their traffic (then raise the factor or set explicit
         capacities). The plain return keeps the dense-FFN contract for the
         model body."""
-        world = jax.lax.axis_size(self.axis)
+        world = _axis_size(self.axis)
         w, ids = self.route(params["router"], x_local)
         ep = self._ep_layer(x_local.shape[0], world)
         grouped, expert_counts, state = ep.dispatch(x_local, ids, w,
@@ -197,7 +199,7 @@ class MoEMLP:
         """Golden/baseline path: same math via jnp + XLA collectives —
         every device computes the FULL expert set over the gathered batch
         at worst-case capacity (zero drops), then keeps its M-shard."""
-        world = jax.lax.axis_size(self.axis)
+        world = _axis_size(self.axis)
         x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
         n = x_full.shape[0]
         w, ids = self.route(params["router"], x_full)
@@ -237,7 +239,7 @@ def _build_fwd(layer: MoEMLP, mesh: Mesh, mode: str, interpret):
         raise ValueError(f"unknown mode {mode!r}")
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(layer.param_specs(), P(axis, None)),
             out_specs=P(axis, None),

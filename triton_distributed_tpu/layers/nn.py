@@ -107,14 +107,24 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
 
     q:            (B, L, Hq, dh)   new queries (rope'd)
     k/v_cache:    (B, S, Hkv, dh)  already contain the new keys/values
-    offset:       ()               int32 — cache length BEFORE this call
+    offset:       () or (B,)       int32 — cache length BEFORE this call;
+                  a (B,) vector is the serving path's PER-SLOT offsets
+                  (continuous batching: every row at its own depth). The
+                  scalar form is the broadcast special case — identical
+                  math, so Engine and the batched serving step share this
+                  one helper.
     seq_lens:     (B,) int32 or None — varlen prefill (cu_seqlens-style,
                   see kernels/sp_attention.flash_prefill): row b's valid
                   queries/keys are its first seq_lens[b] positions after
-                  ``offset``; padding rows return zeros. L > 1 only.
+                  row b's offset; padding rows return zeros. L > 1 only.
     -> (B, L, Hq, dh) in q.dtype
     """
     B, L, Hq, dh = q.shape
+    offset = jnp.asarray(offset, jnp.int32)
+    off_rows = offset.reshape(-1)          # (1,) scalar or (B,) per-slot
+    if off_rows.shape[0] not in (1, B):
+        raise ValueError(f"offset shape {offset.shape} is neither scalar "
+                         f"nor per-row ({B},)")
     if seq_lens is not None and L == 1:
         # Contract check BEFORE the flash-decode gate: the kernel would
         # silently ignore seq_lens and attend the whole cache.
@@ -132,6 +142,8 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
             flash_decode_local,
         )
 
+        # kv_len rides the scalar-or-vector offset shape: the kernel masks
+        # per row either way (serving's staggered slot depths included).
         out, _ = flash_decode_local(
             q.reshape(B, Hq, dh), k_cache, v_cache, kv_len=offset + 1,
             scale=scale, kv_layout="bshd", interpret=interpret)
@@ -143,8 +155,6 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
         from triton_distributed_tpu.kernels.sp_attention import flash_prefill
 
         out = flash_prefill(q, k_cache, v_cache, offset=offset,
-                            kv_len=None if seq_lens is not None else
-                            offset + L,
                             seq_lens=seq_lens, scale=scale,
                             kv_layout="bshd", interpret=interpret)
         if out is not None:
@@ -163,19 +173,19 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     scores = jnp.einsum("blhgd,bshd->blhgs", qr, k_cache,
                         preferred_element_type=jnp.float32) * scale
 
-    q_pos = offset + jnp.arange(L)                       # (L,)
+    q_pos = off_rows[:, None] + jnp.arange(L)            # (1|B, L)
     key_pos = jnp.arange(S)                              # (S,)
-    mask = key_pos[None, :] <= q_pos[:, None]            # causal & in-cache
+    mask = key_pos[None, None, :] <= q_pos[..., None]    # causal & in-cache
     if seq_lens is not None:
-        # Per-row varlen: keys past offset+seq_lens[b] and query rows past
-        # seq_lens[b] are padding (same semantics as the flash kernel).
-        kv_lens = offset + seq_lens                      # (B,)
-        rowmask = (mask[None]
+        # Per-row varlen: keys past offset[b]+seq_lens[b] and query rows
+        # past seq_lens[b] are padding (same semantics as the flash kernel).
+        kv_lens = off_rows + seq_lens                    # (B,)
+        rowmask = (mask
                    & (key_pos[None, None, :] < kv_lens[:, None, None])
                    & (jnp.arange(L)[None, :, None] < seq_lens[:, None, None]))
         scores = jnp.where(rowmask[:, :, None, None, :], scores, _NEG_INF)
     else:
-        scores = jnp.where(mask[:, None, None, :], scores, _NEG_INF)
+        scores = jnp.where(mask[:, :, None, None, :], scores, _NEG_INF)
 
     p = jax.nn.softmax(scores, axis=-1)
     # DECODE fast path (use_flash_decode=True, L=1 fell back here):
@@ -201,6 +211,44 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
 
 def cache_update(cache, new, offset):
     """Write ``new`` (B, L, H, dh) into ``cache`` (B, S, H, dh) at ``offset``
-    along the sequence dim. Functional: returns the new cache array."""
-    return jax.lax.dynamic_update_slice(
-        cache, new.astype(cache.dtype), (0, offset, 0, 0))
+    along the sequence dim. Functional: returns the new cache array.
+
+    ``offset`` may be () — one slice write for the whole batch (the Engine
+    path) — or (B,) per-row offsets (the serving path's staggered slot
+    depths), which lower to one scatter with row b's tokens landing at
+    ``[offset[b], offset[b] + L)``.
+    """
+    offset = jnp.asarray(offset, jnp.int32)
+    if offset.ndim == 0:
+        return jax.lax.dynamic_update_slice(
+            cache, new.astype(cache.dtype), (0, offset, 0, 0))
+    B, L = new.shape[:2]
+    pos = offset[:, None] + jnp.arange(L, dtype=jnp.int32)[None]   # (B, L)
+    return cache.at[jnp.arange(B)[:, None], pos].set(new.astype(cache.dtype))
+
+
+def paged_cache_update(pool, new, block_tables, offsets, write_mask=None):
+    """Write ``new`` (B, L, H, dh) into a block-paged KV pool layer
+    (n_blocks, block_size, H, dh) at per-slot positions — the
+    PagedAttention write: token (b, l) lands in block
+    ``block_tables[b, (offsets[b] + l) // block_size]`` at line
+    ``(offsets[b] + l) % block_size``. Functional: returns the new pool.
+
+    ``write_mask`` — (B,) slot mask or (B, L) per-token mask (varlen
+    chunked prefill: only row b's first seq_lens[b] tokens are real) —
+    DROPS masked writes entirely (routed out of range under scatter mode
+    'drop'), so inactive slots and padding rows can never corrupt blocks
+    owned by live sequences.
+    """
+    B, L = new.shape[:2]
+    n_blocks, bs = pool.shape[:2]
+    pos = (jnp.asarray(offsets, jnp.int32)[:, None]
+           + jnp.arange(L, dtype=jnp.int32)[None])                 # (B, L)
+    slot = jnp.minimum(pos // bs, block_tables.shape[1] - 1)
+    blk = jnp.take_along_axis(block_tables, slot, axis=1)          # (B, L)
+    # Positions past the table (padding rows with huge offsets) are clamped
+    # by the minimum above; the mask below is what actually drops them.
+    if write_mask is not None:
+        wm = (write_mask if write_mask.ndim == 2 else write_mask[:, None])
+        blk = jnp.where(wm, blk, n_blocks)          # out of range -> dropped
+    return pool.at[blk, pos % bs].set(new.astype(pool.dtype), mode="drop")

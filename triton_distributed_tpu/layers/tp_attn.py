@@ -26,6 +26,7 @@ from __future__ import annotations
 import dataclasses
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -129,14 +130,27 @@ class TPAttn:
 
     def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world,
                      use_flash_decode: bool = True, seq_lens=None,
-                     interpret=None):
+                     interpret=None, block_tables=None, slot_mask=None):
         """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
         output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
         cache-append -> GQA-attend pipeline shared by every mode
         (reference tp_attn.py:217-233). Decode steps (L == 1) stream the KV
         cache through the split-KV Pallas kernel unless
         ``use_flash_decode=False`` (the xla golden mode stays dense jnp so
-        mode-equality tests compare kernel against reference math)."""
+        mode-equality tests compare kernel against reference math).
+
+        Two cache layouts, one pipeline:
+        - contiguous (``block_tables=None``): k/v_cache (B, S, Hkv, dh),
+          ``offset`` () scalar (the Engine path) or (B,) per-row.
+        - PAGED (serving): k/v_cache are one layer of the block pool
+          (n_blocks, block_size, Hkv, dh); ``block_tables`` (B, max_blocks)
+          maps each slot's sequence onto pool blocks, ``offset`` is the
+          (B,) per-slot depth vector, and ``slot_mask`` (B,) drops dead
+          slots' cache writes. New K/V scatter into the pool, attention
+          reads through a block-table gather (sp_attention.paged_gather_kv)
+          — so arriving/finishing sequences are pure DATA changes and the
+          step never retraces.
+        """
         B, L, _ = qkv.shape
         qs, kvs = self.sizes(world)
         dh = self.head_dim
@@ -146,14 +160,35 @@ class TPAttn:
         if self.qk_norm:
             q = nn.rms_norm(q, params["q_norm"], self.rms_eps)
             k = nn.rms_norm(k, params["k_norm"], self.rms_eps)
-        positions = offset + jnp.arange(L)
+        offset = jnp.asarray(offset, jnp.int32)
+        # (1|B, L): per-row positions when offset is the per-slot vector.
+        positions = offset.reshape(-1, 1) + jnp.arange(L)
         cos, sin = nn.rope_angles(positions, dh, self.rope_theta,
                                   self.rope_scaling)
         q = nn.apply_rope(q, cos, sin)
         k = nn.apply_rope(k, cos, sin)
-        k_cache = nn.cache_update(k_cache, k, offset)
-        v_cache = nn.cache_update(v_cache, v, offset)
-        out = nn.attn_with_cache(q, k_cache, v_cache, offset,
+        if block_tables is None:
+            k_cache = nn.cache_update(k_cache, k, offset)
+            v_cache = nn.cache_update(v_cache, v, offset)
+            k_view, v_view = k_cache, v_cache
+        else:
+            from triton_distributed_tpu.kernels.sp_attention import (
+                paged_gather_kv,
+            )
+
+            wm = slot_mask                              # (B,) or None
+            if seq_lens is not None:
+                tok_valid = jnp.arange(L)[None] < seq_lens[:, None]
+                wm = tok_valid if wm is None else (wm[:, None] & tok_valid)
+            k_cache = nn.paged_cache_update(k_cache, k, block_tables,
+                                            offset, wm)
+            v_cache = nn.paged_cache_update(v_cache, v, block_tables,
+                                            offset, wm)
+            k_view = paged_gather_kv(k_cache, block_tables,
+                                     slot_mask=slot_mask)
+            v_view = paged_gather_kv(v_cache, block_tables,
+                                     slot_mask=slot_mask)
+        out = nn.attn_with_cache(q, k_view, v_view, offset,
                                  scale=dh ** -0.5,
                                  use_flash_decode=use_flash_decode,
                                  seq_lens=seq_lens, interpret=interpret)
@@ -162,11 +197,14 @@ class TPAttn:
     # -- per-device forwards (inside shard_map) -----------------------------
 
     def dist_fwd(self, params, x_local, k_cache, v_cache, offset, *,
-                 seq_lens=None, interpret=None):
+                 seq_lens=None, interpret=None, block_tables=None,
+                 slot_mask=None):
         """x_local: (B_local, L, d) batch-shard -> same layout out.
         AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203).
-        ``seq_lens``: (B,) varlen prefill lengths (nn.attn_with_cache)."""
-        world = jax.lax.axis_size(self.axis)
+        ``seq_lens``: (B,) varlen prefill lengths (nn.attn_with_cache).
+        ``block_tables``/``slot_mask``: paged-KV serving path
+        (``_qkv_to_attn``) — both cover the FULL batch, replicated."""
+        world = _axis_size(self.axis)
         Bl, L, d = x_local.shape
         qkv = ag_gemm_device(
             x_local.reshape(Bl * L, d), params["w_qkv"], axis=self.axis,
@@ -174,7 +212,8 @@ class TPAttn:
         qkv = qkv.reshape(world * Bl, L, -1)
         out, k_cache, v_cache = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world, seq_lens=seq_lens,
-            interpret=interpret)
+            interpret=interpret, block_tables=block_tables,
+            slot_mask=slot_mask)
         out = gemm_rs_device(
             out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
             config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
@@ -182,29 +221,34 @@ class TPAttn:
         return out.reshape(Bl, L, d), k_cache, v_cache
 
     def ar_fwd(self, params, x_full, k_cache, v_cache, offset, *,
-               interpret=None):
+               interpret=None, seq_lens=None, block_tables=None,
+               slot_mask=None):
         """x_full: (B, L, d) replicated -> replicated out.
         Local GEMMs -> one-shot allreduce (reference dist_triton_AR_fwd)."""
-        world = jax.lax.axis_size(self.axis)
+        world = _axis_size(self.axis)
         B, L, d = x_full.shape
         qkv = x_full @ params["w_qkv"]
         out, k_cache, v_cache = self._qkv_to_attn(
-            params, qkv, k_cache, v_cache, offset, world, interpret=interpret)
+            params, qkv, k_cache, v_cache, offset, world, interpret=interpret,
+            seq_lens=seq_lens, block_tables=block_tables,
+            slot_mask=slot_mask)
         partial = out.reshape(B * L, -1) @ params["w_o"]
         out = oneshot_all_reduce(partial, axis=self.axis, interpret=interpret)
         return out.reshape(B, L, d), k_cache, v_cache
 
-    def xla_fwd(self, params, x_local, k_cache, v_cache, offset):
+    def xla_fwd(self, params, x_local, k_cache, v_cache, offset, *,
+                seq_lens=None, block_tables=None, slot_mask=None):
         """Golden/baseline path: same math via jnp + XLA collectives.
         Batch-sharded in/out like ``dist_fwd``."""
-        world = jax.lax.axis_size(self.axis)
+        world = _axis_size(self.axis)
         Bl, L, d = x_local.shape
         x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
         qkv = x_full.reshape(world * Bl * L, d) @ params["w_qkv"]
         qkv = qkv.reshape(world * Bl, L, -1)
         out, k_cache, v_cache = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world,
-            use_flash_decode=False)
+            use_flash_decode=False, seq_lens=seq_lens,
+            block_tables=block_tables, slot_mask=slot_mask)
         partial = out.reshape(world * Bl * L, -1) @ params["w_o"]
         out = jax.lax.psum_scatter(partial, self.axis, scatter_dimension=0,
                                    tiled=True)

@@ -26,6 +26,8 @@ import functools
 from typing import Literal
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -161,7 +163,7 @@ def _build_fwd(layer: TPMLP, mesh: Mesh, mode: str, interpret):
             # hand back this device's M-shard so the layout matches.
             x_full = jax.lax.all_gather(xl, axis, axis=0, tiled=True)
             out = layer.ar_fwd(params, x_full, interpret=interpret)
-            world = jax.lax.axis_size(axis)
+            world = _axis_size(axis)
             m = out.shape[0] // world
             me = jax.lax.axis_index(axis)
             return jax.lax.dynamic_slice_in_dim(out, me * m, m, axis=0)
@@ -169,7 +171,7 @@ def _build_fwd(layer: TPMLP, mesh: Mesh, mode: str, interpret):
 
     param_specs = {"w_gate_up": P(None, axis), "w_down": P(axis, None)}
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             f, mesh=mesh,
             in_specs=(param_specs, P(axis, None)),
             out_specs=P(axis, None),

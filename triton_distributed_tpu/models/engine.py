@@ -19,6 +19,7 @@ from __future__ import annotations
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -67,21 +68,51 @@ class Engine:
 
     # -- compiled step ------------------------------------------------------
 
-    def _make_sm(self, mode: str, *, moe_stats: bool = False):
+    def _make_sm(self, mode: str, *, moe_stats: bool = False,
+                 paged: str | None = None):
         """The per-mode shard_map of the model forward — the ONE definition
         of the step sharding, shared by the per-step jit (``_step_fn``),
         the scanned loop (``_serve_scanned_fn``), and the drop-stats audit
-        (``moe_stats=True`` appends the replicated counters output)."""
+        (``moe_stats=True`` appends the replicated counters output).
+
+        ``paged='decode'|'prefill'`` builds the continuous-batching serving
+        variants (``serving/batch_engine.py``): the caches become the
+        block-paged pool (same spec — kv-heads at index 3 either way) and
+        the call takes extra replicated data operands
+        (offsets, block_tables, slot_mask[, seq_lens]) so slot churn never
+        changes a shape."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
         out_specs = ((P(), kspec, vspec, P()) if moe_stats
                      else (P(), kspec, vspec))
-        return jax.shard_map(
-            functools.partial(model.forward_device, mode=mode,
-                              interpret=self.interpret,
-                              return_moe_stats=moe_stats),
+        if paged is None:
+            fwd = functools.partial(model.forward_device, mode=mode,
+                                    interpret=self.interpret,
+                                    return_moe_stats=moe_stats)
+            in_specs = (model.param_specs(), P(), kspec, vspec, P())
+        elif paged == "decode":
+            def fwd(params, ids, kp, vp, offsets, block_tables, slot_mask):
+                return model.forward_device(
+                    params, ids, kp, vp, offsets, mode=mode,
+                    interpret=self.interpret, block_tables=block_tables,
+                    slot_mask=slot_mask)
+            in_specs = (model.param_specs(), P(), kspec, vspec,
+                        P(), P(), P())
+        elif paged == "prefill":
+            def fwd(params, ids, kp, vp, offsets, block_tables, slot_mask,
+                    seq_lens):
+                return model.forward_device(
+                    params, ids, kp, vp, offsets, mode=mode,
+                    interpret=self.interpret, block_tables=block_tables,
+                    slot_mask=slot_mask, seq_lens=seq_lens)
+            in_specs = (model.param_specs(), P(), kspec, vspec,
+                        P(), P(), P(), P())
+        else:
+            raise ValueError(f"unknown paged variant {paged!r}")
+        return shard_map(
+            fwd,
             mesh=self.mesh,
-            in_specs=(model.param_specs(), P(), kspec, vspec, P()),
+            in_specs=in_specs,
             out_specs=out_specs,
             check_vma=False,
         )

@@ -10,7 +10,12 @@ monotonic offset. Differences by design:
 - Sharded over the TP axis on the kv-head dim (the reference allocates
   ``kv_heads // world_size`` per rank; here the mesh does it).
 - A single scalar ``offset`` (the reference keeps a per-batch vector but
-  only ever advances it uniformly — engine.py:150 ``inc_offset``).
+  only ever advances it uniformly — engine.py:150 ``inc_offset``). The
+  attention layer itself accepts either a scalar or a (B,) per-row vector
+  (``nn.cache_update`` / ``nn.attn_with_cache``); the continuous-batching
+  serving path (``serving/kv_pool.py``) uses the vector form over a
+  block-paged pool instead of this contiguous per-sequence cache, and
+  shares ``spec()`` — both layouts carry kv-heads at index 3.
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import dataclasses
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 
 @jax.tree_util.register_dataclass
@@ -38,7 +43,9 @@ class KVCache:
         k = jnp.zeros(shape, config.dtype)
         v = jnp.zeros(shape, config.dtype)
         if mesh is not None:
-            sh = NamedSharding(mesh, cls.spec(axis)[0])
+            from triton_distributed_tpu.runtime.mesh import sharding_for
+
+            sh = sharding_for(cls.spec(axis)[0], mesh)
             k, v = jax.device_put(k, sh), jax.device_put(v, sh)
         return cls(k=k, v=v, offset=jnp.int32(0))
 

@@ -29,6 +29,7 @@ import dataclasses
 import functools
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -269,12 +270,25 @@ class Qwen3:
 
     def forward_device(self, params, ids, k_cache, v_cache, offset, *,
                        mode: str = "dist", interpret=None,
-                       return_moe_stats: bool = False):
+                       return_moe_stats: bool = False, seq_lens=None,
+                       block_tables=None, slot_mask=None):
         """One forward step on this device.
 
         ids: (B, L) int32, replicated. k/v_cache: this device's shard
         (n_layers, B, S, local_kv_heads, dh). offset: () int32.
         Returns (logits (B, vocab) fp32 replicated, new_k, new_v).
+
+        Serving (continuous batching) extensions — all FULL-batch,
+        replicated, and pure data (fixed shapes, so slot churn never
+        retraces):
+          offset       may be a (B,) per-slot depth vector.
+          seq_lens     (B,) valid new-token counts per row (chunked varlen
+                       prefill); the returned logits row b comes from
+                       position ``seq_lens[b]-1`` instead of ``L-1``.
+          block_tables (B, max_blocks) int32 + ``slot_mask`` (B,) bool
+                       switch the caches to the block-paged pool layout
+                       (n_layers, n_blocks, block_size, local_kv_heads, dh)
+                       — see ``TPAttn._qkv_to_attn``.
 
         ``return_moe_stats=True`` (MoE + mode='dist' only) appends a 4th
         output: ``{"n_dropped_dispatch", "n_dropped_expert"}`` int32 totals
@@ -286,7 +300,7 @@ class Qwen3:
         explicit capacities if nonzero).
         """
         c = self.config
-        world = jax.lax.axis_size(self.axis)
+        world = _axis_size(self.axis)
         B, L = ids.shape
         if mode in ("dist", "xla"):
             if B % world:
@@ -334,12 +348,21 @@ class Qwen3:
             hn = nn.rms_norm(h, lp["input_norm"], c.rms_eps)
             if mode == "dist":
                 a, kc, vc = attn.dist_fwd(lp["attn"], hn, kc, vc, offset,
-                                          interpret=interpret)
+                                          interpret=interpret,
+                                          seq_lens=seq_lens,
+                                          block_tables=block_tables,
+                                          slot_mask=slot_mask)
             elif mode == "xla":
-                a, kc, vc = attn.xla_fwd(lp["attn"], hn, kc, vc, offset)
+                a, kc, vc = attn.xla_fwd(lp["attn"], hn, kc, vc, offset,
+                                         seq_lens=seq_lens,
+                                         block_tables=block_tables,
+                                         slot_mask=slot_mask)
             else:
                 a, kc, vc = attn.ar_fwd(lp["attn"], hn, kc, vc, offset,
-                                        interpret=interpret)
+                                        interpret=interpret,
+                                        seq_lens=seq_lens,
+                                        block_tables=block_tables,
+                                        slot_mask=slot_mask)
             h = resid + a
             resid = h
             hn = nn.rms_norm(h, lp["post_norm"], c.rms_eps)
@@ -376,7 +399,16 @@ class Qwen3:
                 body, h, (scan_layers, k_cache, v_cache, layer_ids))
 
         h = nn.rms_norm(h, params["final_norm"], c.rms_eps)
-        last = h[:, -1]                                        # (*, d)
+        if seq_lens is None:
+            last = h[:, -1]                                    # (*, d)
+        else:
+            # Varlen chunk: row b's next-token logits live at its last
+            # VALID position. Rows with seq_lens == 0 clamp to position 0
+            # (garbage the caller masks out).
+            idx = jnp.maximum(jnp.asarray(seq_lens, jnp.int32) - 1, 0)
+            if mode in ("dist", "xla"):
+                idx = jax.lax.dynamic_slice_in_dim(idx, me * bl, bl, axis=0)
+            last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
         if mode in ("dist", "xla"):
             last = jax.lax.all_gather(last, self.axis, axis=0, tiled=True)
         lm_head = (params["embed"].T if c.tie_embeddings

@@ -25,6 +25,7 @@ import os
 from typing import Mapping, Sequence
 
 import jax
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: F401
 
@@ -161,6 +162,13 @@ def make_2d_mesh(topology: Topology | None = None, *,
                      devices=devices, set_default=set_default)
 
 
+def sharding_for(spec: P, mesh: Mesh | None = None) -> NamedSharding:
+    """NamedSharding of ``spec`` on ``mesh`` (default mesh when omitted) —
+    the one-liner every buffer allocator needs at placement time (KVCache,
+    the serving KV pool)."""
+    return NamedSharding(mesh or get_default_mesh(), spec)
+
+
 def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
@@ -174,7 +182,7 @@ def global_rank(ici_axis: str, dcn_axis: str | None = None):
 
     me = jax.lax.axis_index(ici_axis)
     if dcn_axis is not None:
-        me = jax.lax.axis_index(dcn_axis) * jax.lax.axis_size(ici_axis) + me
+        me = jax.lax.axis_index(dcn_axis) * _axis_size(ici_axis) + me
     return me
 
 
@@ -182,9 +190,9 @@ def global_world(ici_axis: str, dcn_axis: str | None = None) -> int:
     """Total world across the (dcn, ici) axes; call inside shard_map."""
     import jax
 
-    w = jax.lax.axis_size(ici_axis)
+    w = _axis_size(ici_axis)
     if dcn_axis is not None:
-        w *= jax.lax.axis_size(dcn_axis)
+        w *= _axis_size(dcn_axis)
     return w
 
 

@@ -44,8 +44,14 @@ def resolve_interpret(interpret: InterpretFlag = None, *, detect_races: bool = F
 
     if interpret is None:
         interpret = not on_tpu()
-    if isinstance(interpret, pltpu.InterpretParams):
+    params_cls = getattr(pltpu, "InterpretParams", None)
+    if params_cls is None:
+        # Old jax has no TPU-interpreter params class: fall back to the
+        # generic Pallas interpreter (no race detector, coarser DMA
+        # simulation). Anything non-bool was meant as params -> True.
+        return interpret if isinstance(interpret, bool) else True
+    if isinstance(interpret, params_cls):
         return interpret
     if interpret is True:
-        return pltpu.InterpretParams(detect_races=detect_races)
+        return params_cls(detect_races=detect_races)
     return interpret  # explicit False: compiled path, even with detect_races

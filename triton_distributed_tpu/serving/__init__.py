@@ -1,0 +1,20 @@
+"""Continuous-batching serving subsystem.
+
+Orca-style iteration-level scheduling + vLLM-style block-paged KV memory
+on top of the TP engine, with every piece of runtime dynamism (arrivals,
+departures, preemptions, staggered sequence depths) expressed as DATA into
+two fixed-shape compiled steps. See docs/serving.md for the design note.
+
+  KVPool / PagedKVState  — block-paged KV memory + free-list allocator
+  Scheduler / Request    — priority-FIFO queue, admission, eviction policy
+  BatchEngine            — the compiled decode/mixed steps + serve loop
+  Metrics                — counters / gauges / histograms for the above
+"""
+
+from triton_distributed_tpu.serving.batch_engine import BatchEngine
+from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
+from triton_distributed_tpu.serving.metrics import Histogram, Metrics
+from triton_distributed_tpu.serving.scheduler import Request, Scheduler
+
+__all__ = ["BatchEngine", "KVPool", "PagedKVState", "Histogram", "Metrics",
+           "Request", "Scheduler"]

@@ -1,0 +1,330 @@
+"""Continuous-batching engine: ONE compiled step, slot churn as data.
+
+The serving-side driver over ``models/engine.Engine``'s model + mesh: a
+fixed bank of ``n_slots`` sequence slots runs through TWO jitted programs —
+
+  decode step  (n_slots, 1)-token ids      — one token for every slot
+  mixed step   (n_slots, prefill_chunk)    — chunked varlen prefill rows
+                                             AND 1-token decode rows in the
+                                             same iteration (Orca-style
+                                             iteration-level batching)
+
+— whose operands (active-slot mask, per-slot offsets, block tables,
+per-row seq_lens) are plain DATA. Requests arriving, finishing, getting
+preempted or re-admitted never change a shape, so each step compiles
+exactly once for the slot bank (``trace_counts`` proves it; the tests
+assert on it). The reference engine gets this from CUDA-Graph replay over
+a fixed batch; here XLA executable replay plays that role with the
+dynamism pushed into masks — the TPU-idiomatic translation.
+
+KV lives in the block-paged ``KVPool`` (vLLM-style), so HBM holds
+sequences at their actual lengths; when the pool runs dry the scheduler
+evicts by recompute (``serving/scheduler.py``) and the victim's re-prefill
+reproduces its greedy continuation exactly.
+
+Bit-exactness contract (tests/test_serving.py): under greedy sampling the
+slot-batched run emits the SAME tokens as N independent single-sequence
+``Engine`` runs — masked cache positions contribute exact zeros, every
+per-row op is row-independent, and chunked prefill attends causally so
+later-chunk keys never influence earlier logits.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.engine import Engine
+from triton_distributed_tpu.models.sampling import sample_token
+from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
+from triton_distributed_tpu.serving.metrics import Metrics
+from triton_distributed_tpu.serving.scheduler import Request, Scheduler
+
+
+@dataclasses.dataclass
+class _Slot:
+    """Host bookkeeping for one occupied batch slot."""
+
+    req: Request
+    admit_seq: int
+    ctx: list[int]          # prompt + pre-preemption output: what to prefill
+    offset: int = 0         # tokens written into the pool so far
+    last_tok: int = 0       # pending decode input (valid once offset>=len(ctx))
+
+    @property
+    def prefilling(self) -> bool:
+        return self.offset < len(self.ctx)
+
+
+class BatchEngine:
+    """Continuous-batching server over an ``Engine``'s model/params/mesh.
+
+    ``n_slots``    fixed batch width (must divide by the TP world in
+                   dist/xla modes — the hidden states are batch-sharded).
+    ``n_blocks``   KV pool size; defaults to full residency for all slots
+                   (no preemption pressure). Size it below
+                   ``n_slots * ceil(max_seq_len/block_size)`` to oversubscribe.
+    ``prefill_chunk`` tokens of prompt consumed per mixed step and the
+                   mixed step's fixed ids width.
+    """
+
+    def __init__(self, engine: Engine, *, n_slots: int = 8,
+                 n_blocks: int | None = None, block_size: int = 16,
+                 prefill_chunk: int = 32, max_seq_len: int | None = None,
+                 seed: int = 0):
+        self.engine = engine
+        world = engine.mesh.shape[engine.model.axis]
+        if engine.decode_mode in ("dist", "xla") and n_slots % world:
+            raise ValueError(f"n_slots {n_slots} not divisible by TP world "
+                             f"{world} (required in dist/xla modes)")
+        self.n_slots = n_slots
+        self.prefill_chunk = prefill_chunk
+        max_seq_len = max_seq_len or engine.max_length
+        if n_blocks is None:
+            n_blocks = n_slots * -(-max_seq_len // block_size)
+        self.pool = KVPool(engine.config, n_blocks=n_blocks,
+                           block_size=block_size, max_seq_len=max_seq_len,
+                           mesh=engine.mesh, axis=engine.model.axis)
+        self.scheduler = Scheduler()
+        self.metrics = Metrics()
+        self.trace_counts = {"decode": 0, "prefill": 0}
+        self._slots: list[_Slot | None] = [None] * n_slots
+        self._admit_seq = 0
+        self._req_counter = 0
+        self._finished: dict[object, Request] = {}
+        self._key = jax.random.PRNGKey(seed)
+        self._build_steps()
+
+    # -- compiled steps -----------------------------------------------------
+
+    def _build_steps(self):
+        eng = self.engine
+        V = eng.config.vocab_size
+        sm_dec = eng._make_sm(eng.decode_mode, paged="decode")
+        sm_pre = eng._make_sm(eng.prefill_mode, paged="prefill")
+        temperature, top_p = eng.temperature, eng.top_p
+        trace_counts = self.trace_counts
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def decode_step(params, tok, k, v, offsets, block_tables, slot_mask,
+                        key):
+            # Trace-time side effect: counts COMPILATIONS, not calls — the
+            # one-compile-across-churn guarantee the tests assert on.
+            trace_counts["decode"] += 1
+            ids = jnp.clip(tok, 0, V - 1)[:, None]
+            logits, k, v = sm_dec(params, ids, k, v, offsets, block_tables,
+                                  slot_mask)
+            nxt = sample_token(logits, key, temperature=temperature,
+                               top_p=top_p)
+            return nxt, k, v
+
+        @functools.partial(jax.jit, donate_argnums=(2, 3))
+        def mixed_step(params, ids, k, v, offsets, block_tables, slot_mask,
+                       seq_lens, key):
+            trace_counts["prefill"] += 1
+            ids = jnp.clip(ids, 0, V - 1)
+            logits, k, v = sm_pre(params, ids, k, v, offsets, block_tables,
+                                  slot_mask, seq_lens)
+            nxt = sample_token(logits, key, temperature=temperature,
+                               top_p=top_p)
+            return nxt, k, v
+
+        self._decode_step = decode_step
+        self._mixed_step = mixed_step
+
+    def _next_key(self):
+        if self.engine.temperature == 0.0:
+            return None        # greedy: sample_token never touches the key
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    # -- request lifecycle --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               req_id=None) -> object:
+        """Queue one request; returns its id (used as the pool seq id)."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt or max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and max_new_tokens>=1")
+        total = len(prompt) + max_new_tokens
+        if total > self.pool.max_seq_len:
+            raise ValueError(f"prompt+max_new_tokens ({total}) exceeds pool "
+                             f"max_seq_len ({self.pool.max_seq_len})")
+        if self.pool.blocks_for(total) > self.pool.n_blocks:
+            raise ValueError(f"request needs {self.pool.blocks_for(total)} "
+                             f"blocks; pool has {self.pool.n_blocks} total")
+        if req_id is None:
+            req_id = f"req-{self._req_counter}"
+        self._req_counter += 1
+        req = Request(req_id=req_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens, priority=priority,
+                      submit_t=time.monotonic())
+        self.scheduler.submit(req)
+        return req_id
+
+    def _admit(self):
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return
+        admitted = self.scheduler.admit(free_slots=len(free),
+                                        free_blocks=self.pool.n_free,
+                                        block_size=self.pool.block_size)
+        for req in admitted:
+            ctx = req.prompt + req.output
+            ok = self.pool.ensure(req.req_id, len(ctx) + 1)
+            assert ok, "scheduler admitted beyond the pool budget"
+            self._slots[free.pop(0)] = _Slot(req=req,
+                                             admit_seq=self._admit_seq,
+                                             ctx=ctx)
+            self._admit_seq += 1
+            self.metrics.inc("requests_admitted")
+
+    def _preempt(self, idx: int):
+        s = self._slots[idx]
+        self.pool.release(s.req.req_id)
+        s.req.n_preemptions += 1
+        self.scheduler.requeue(s.req)
+        self._slots[idx] = None
+        self.metrics.inc("preemptions")
+
+    def _ensure_or_preempt(self, idx: int) -> bool:
+        """Grow slot ``idx``'s table for its next token write, evicting
+        victims (possibly ``idx`` itself) until the allocation fits."""
+        s = self._slots[idx]
+        while not self.pool.ensure(s.req.req_id, s.offset + 1):
+            victim = Scheduler.select_victim(
+                (j, t.req, t.admit_seq)
+                for j, t in enumerate(self._slots) if t is not None)
+            assert victim is not None, "no evictable slot but pool is full"
+            self._preempt(victim)
+            if victim == idx:
+                return False
+        return True
+
+    def _finish(self, idx: int):
+        s = self._slots[idx]
+        s.req.finish_t = time.monotonic()
+        self.pool.release(s.req.req_id)
+        self._slots[idx] = None
+        self._finished[s.req.req_id] = s.req
+        self.metrics.inc("requests_completed")
+        self.metrics.observe("e2e_latency_s", s.req.finish_t - s.req.submit_t)
+
+    def _record_token(self, s: _Slot, tok: int):
+        s.req.output.append(tok)
+        s.last_tok = tok
+        self.metrics.inc("tokens_generated")
+        if s.req.first_token_t is None:
+            s.req.first_token_t = time.monotonic()
+            self.metrics.observe("ttft_s",
+                                 s.req.first_token_t - s.req.submit_t)
+
+    # -- iteration ----------------------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, then run one compiled step.
+        Returns False when there is nothing to do (idle)."""
+        self._admit()
+        # Decode rows write one token this step — make room first (prefill
+        # rows were fully funded at admission).
+        for i in range(self.n_slots):
+            s = self._slots[i]
+            if s is not None and not s.prefilling:
+                self._ensure_or_preempt(i)
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        self.metrics.set_gauge("queue_depth", len(self.scheduler))
+        self.metrics.set_gauge("active_slots", len(active))
+        self.metrics.set_gauge("pool_free_blocks", self.pool.n_free)
+        self.metrics.set_gauge("pool_occupancy",
+                               self.pool.n_used / self.pool.n_blocks)
+        if not active:
+            return False
+        if any(self._slots[i].prefilling for i in active):
+            self._run_mixed()
+        else:
+            self._run_decode()
+        return True
+
+    def _operands(self):
+        sids = [s.req.req_id if s is not None else None for s in self._slots]
+        offsets = np.array([s.offset if s else 0 for s in self._slots],
+                           np.int32)
+        mask = np.array([s is not None for s in self._slots], bool)
+        tables = self.pool.padded_tables(sids)
+        return (jnp.asarray(offsets), jnp.asarray(tables),
+                jnp.asarray(mask))
+
+    def _run_decode(self):
+        tok = np.array([s.last_tok if s else 0 for s in self._slots],
+                       np.int32)
+        offsets, tables, mask = self._operands()
+        st = self.pool.state
+        nxt, k, v = self._decode_step(self.engine.params, jnp.asarray(tok),
+                                      st.k, st.v, offsets, tables, mask,
+                                      self._next_key())
+        self.pool.state = PagedKVState(k=k, v=v)
+        nxt = np.asarray(nxt)
+        self.metrics.inc("decode_steps")
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.offset += 1
+            self._record_token(s, int(nxt[i]))
+            if s.req.remaining_new == 0:
+                self._finish(i)
+
+    def _run_mixed(self):
+        L = self.prefill_chunk
+        ids = np.zeros((self.n_slots, L), np.int32)
+        seq_lens = np.zeros((self.n_slots,), np.int32)
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            if s.prefilling:
+                take = min(L, len(s.ctx) - s.offset)
+                ids[i, :take] = s.ctx[s.offset:s.offset + take]
+                seq_lens[i] = take
+            else:
+                ids[i, 0] = s.last_tok
+                seq_lens[i] = 1
+        offsets, tables, mask = self._operands()
+        st = self.pool.state
+        nxt, k, v = self._mixed_step(self.engine.params, jnp.asarray(ids),
+                                     st.k, st.v, offsets, tables, mask,
+                                     jnp.asarray(seq_lens),
+                                     self._next_key())
+        self.pool.state = PagedKVState(k=k, v=v)
+        nxt = np.asarray(nxt)
+        self.metrics.inc("prefill_steps")
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            took = int(seq_lens[i])
+            s.offset += took
+            if s.offset < len(s.ctx):
+                continue            # still mid-prompt; logits row is interim
+            self._record_token(s, int(nxt[i]))
+            if s.req.remaining_new == 0:
+                self._finish(i)
+
+    # -- driver -------------------------------------------------------------
+
+    def run(self, max_steps: int | None = None) -> dict:
+        """Step until idle (or ``max_steps``); returns
+        ``{req_id: [generated token ids]}`` for every finished request."""
+        steps = 0
+        while max_steps is None or steps < max_steps:
+            if not self.step():
+                break
+            steps += 1
+        return {rid: list(req.output)
+                for rid, req in self._finished.items()}
+
+    @property
+    def finished(self) -> dict:
+        return dict(self._finished)

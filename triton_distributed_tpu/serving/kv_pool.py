@@ -1,0 +1,148 @@
+"""Block-paged KV pool (PagedAttention-style memory management).
+
+The serving-side replacement for the per-request contiguous ``KVCache``:
+one fixed device allocation of ``n_blocks`` KV blocks per layer
+
+    k, v: (n_layers, n_blocks, block_size, n_kv_heads, head_dim)
+
+plus a HOST-side free-list allocator mapping sequences onto blocks. A
+sequence of ``n`` tokens owns ``ceil(n / block_size)`` blocks, listed in
+order in its block table; internal fragmentation is bounded by one block
+per sequence (the vLLM argument) instead of one ``max_length`` row per
+request, so a fixed HBM budget serves many more concurrent sequences.
+
+Device arrays are a functional pytree (``PagedKVState``) updated in place
+under jit via buffer donation, exactly like ``KVCache``; the pool is
+sharded over the TP axis on the kv-head dim with the SAME PartitionSpec
+(``KVCache.spec``) — both layouts keep kv-heads at index 3, so the paged
+step's shard_map reuses the contiguous cache's one spec definition.
+
+The allocator is deliberately plain Python: allocation decisions are
+host-side control flow between compiled steps (the reference engine makes
+its CUDA-graph-replay decisions on host the same way), and the device step
+consumes only the resulting (block_tables, offsets, slot_mask) DATA — so
+alloc/free churn never retraces anything.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from triton_distributed_tpu.models.kv_cache import KVCache
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKVState:
+    """Device half of the pool: the block arrays (functional pytree)."""
+
+    k: jax.Array   # (n_layers, n_blocks, block_size, n_kv_heads, head_dim)
+    v: jax.Array
+
+    @property
+    def n_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+
+class KVPool:
+    """Fixed block pool + free-list allocator + per-sequence block tables.
+
+    ``n_blocks`` blocks of ``block_size`` tokens each; ``max_seq_len``
+    bounds any one sequence (sets the fixed block-table width the compiled
+    step sees). ``mesh``/``axis`` shard the kv-head dim like ``KVCache``.
+    """
+
+    def __init__(self, config, *, n_blocks: int, block_size: int = 16,
+                 max_seq_len: int | None = None, mesh=None, axis: str = "tp"):
+        if n_blocks <= 0 or block_size <= 0:
+            raise ValueError(f"bad pool geometry ({n_blocks=}, {block_size=})")
+        self.block_size = block_size
+        self.n_blocks = n_blocks
+        self.max_seq_len = max_seq_len or config.max_length
+        self.max_blocks_per_seq = math.ceil(self.max_seq_len / block_size)
+        shape = (config.n_layers, n_blocks, block_size,
+                 config.n_kv_heads, config.head_dim)
+        k = jnp.zeros(shape, config.dtype)
+        v = jnp.zeros(shape, config.dtype)
+        if mesh is not None:
+            from triton_distributed_tpu.runtime.mesh import sharding_for
+
+            sh = sharding_for(KVCache.spec(axis)[0], mesh)
+            k, v = jax.device_put(k, sh), jax.device_put(v, sh)
+        self.state = PagedKVState(k=k, v=v)
+        # LIFO free list, low block ids first out — recently freed blocks
+        # are reused immediately (warm in whatever cache level they touched).
+        self._free: list[int] = list(range(n_blocks - 1, -1, -1))
+        self._tables: dict[object, list[int]] = {}
+
+    # -- allocator ----------------------------------------------------------
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_used(self) -> int:
+        return self.n_blocks - len(self._free)
+
+    def blocks_for(self, n_tokens: int) -> int:
+        return math.ceil(n_tokens / self.block_size)
+
+    def owned(self, seq_id) -> int:
+        """Blocks currently owned by ``seq_id`` (0 if unknown)."""
+        return len(self._tables.get(seq_id, ()))
+
+    def ensure(self, seq_id, n_tokens: int) -> bool:
+        """Grow ``seq_id``'s table until it covers ``n_tokens`` tokens.
+        Returns False (allocating NOTHING) if the free list can't cover the
+        growth — all-or-nothing keeps admission/preemption decisions clean.
+        """
+        if n_tokens > self.max_seq_len:
+            raise ValueError(f"sequence length {n_tokens} exceeds pool "
+                             f"max_seq_len {self.max_seq_len}")
+        table = self._tables.setdefault(seq_id, [])
+        need = self.blocks_for(n_tokens) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            return False
+        table.extend(self._free.pop() for _ in range(need))
+        return True
+
+    def release(self, seq_id) -> None:
+        """Return all of ``seq_id``'s blocks to the free list."""
+        for b in reversed(self._tables.pop(seq_id, [])):
+            self._free.append(b)
+
+    def table(self, seq_id) -> list[int]:
+        return list(self._tables.get(seq_id, ()))
+
+    def padded_tables(self, seq_ids) -> np.ndarray:
+        """(len(seq_ids), max_blocks_per_seq) int32 — slot-ordered block
+        tables, zero-padded (None entries = empty slots), the fixed-shape
+        operand the compiled step consumes."""
+        out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
+        for row, sid in enumerate(seq_ids):
+            if sid is None:
+                continue
+            t = self._tables.get(sid, ())
+            out[row, :len(t)] = t
+        return out
+
+    def check_invariants(self) -> None:
+        """Allocator soundness: free + owned partition the pool exactly."""
+        owned = [b for t in self._tables.values() for b in t]
+        assert len(set(owned)) == len(owned), "block owned twice"
+        assert len(set(self._free)) == len(self._free), "free list duplicate"
+        assert not (set(owned) & set(self._free)), "block both free and owned"
+        assert len(owned) + len(self._free) == self.n_blocks, "blocks leaked"
+        assert all(0 <= b < self.n_blocks for b in owned + self._free)

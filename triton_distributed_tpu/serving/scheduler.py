@@ -1,0 +1,116 @@
+"""Iteration-level request scheduler (Orca-style continuous batching).
+
+Scheduling happens BETWEEN compiled engine iterations, on host: after every
+step the engine asks the scheduler which waiting requests to admit into
+free slots (and, under memory pressure, which running request to evict).
+Requests therefore join and leave the batch at token granularity instead of
+batch granularity — the Orca insight — while the compiled step itself never
+changes shape (``serving/batch_engine.py``).
+
+Policies (deliberately simple, swappable):
+  queue      priority-then-FIFO: a binary heap on (-priority, arrival_seq).
+             Equal-priority traffic is exact FIFO; higher ``priority``
+             values jump the line.
+  admission  admit the head request only if the KV pool can hold its WHOLE
+             prompt plus one generated token right now (all blocks are
+             allocated at admission). No lookahead reservation for future
+             decode growth — that's what preemption is for.
+  preemption ``select_victim``: lowest priority first, latest-admitted
+             first among equals (LIFO — the youngest request has the least
+             sunk prefill work to throw away). Eviction is by RECOMPUTE:
+             the victim's blocks are freed and the request re-queued with
+             its generated tokens appended to the prompt, preserving its
+             original arrival_seq, so under greedy sampling its remaining
+             output is unchanged (the re-prefill of prompt+generated yields
+             the same next token the evicted decode would have).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import itertools
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request. ``prompt`` grows on preemption (recompute);
+    ``output`` accumulates every generated token across preemptions."""
+
+    req_id: object
+    prompt: list[int]
+    max_new_tokens: int
+    priority: int = 0                 # higher = more important
+    arrival_seq: int | None = None    # set once, at first submit
+    output: list[int] = dataclasses.field(default_factory=list)
+    # host-clock timestamps (time.monotonic), filled by the batch engine
+    submit_t: float | None = None
+    first_token_t: float | None = None
+    finish_t: float | None = None
+    n_preemptions: int = 0
+
+    @property
+    def remaining_new(self) -> int:
+        return self.max_new_tokens - len(self.output)
+
+    @property
+    def context_len(self) -> int:
+        """Tokens to prefill at (re-)admission: the original prompt plus
+        everything generated before a preemption (eviction-by-recompute)."""
+        return len(self.prompt) + len(self.output)
+
+
+class Scheduler:
+    """Priority-FIFO waiting queue + admission control + victim selection."""
+
+    def __init__(self):
+        self._heap: list[tuple[int, int, Request]] = []
+        self._seq = itertools.count()
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def submit(self, req: Request) -> None:
+        if req.arrival_seq is None:
+            req.arrival_seq = next(self._seq)
+        heapq.heappush(self._heap, (-req.priority, req.arrival_seq, req))
+
+    # A preempted request keeps its arrival_seq, so it re-enters the queue
+    # at its original FIFO position within its priority class.
+    requeue = submit
+
+    def peek(self) -> Request | None:
+        return self._heap[0][2] if self._heap else None
+
+    def pop(self) -> Request:
+        return heapq.heappop(self._heap)[2]
+
+    def admit(self, *, free_slots: int, free_blocks: int,
+              block_size: int) -> list[Request]:
+        """Head-of-line admission: pop requests while a slot is free and the
+        pool can hold prompt+1 tokens. Stops at the first request that does
+        not fit (no skip-ahead — skipping would starve big requests)."""
+        admitted: list[Request] = []
+        budget = free_blocks
+        while len(admitted) < free_slots and self._heap:
+            head = self.peek()
+            need = -(-(head.context_len + 1) // block_size)  # ceil
+            if need > budget:
+                break
+            budget -= need
+            admitted.append(self.pop())
+        return admitted
+
+    @staticmethod
+    def select_victim(running, *, exclude=()):
+        """Pick the eviction victim among ``running`` (iterable of
+        (key, Request, admit_seq)): lowest priority, then latest admitted.
+        Returns the winning key, or None if nothing is evictable."""
+        best = None
+        for key, req, admit_seq in running:
+            if key in exclude:
+                continue
+            rank = (req.priority, -admit_seq)
+            if best is None or rank < best[0]:
+                best = (rank, key)
+        return None if best is None else best[1]

@@ -37,6 +37,7 @@ import time
 from typing import Any, Callable
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
@@ -86,7 +87,7 @@ def _spec_ag_gemm(mesh):
     def f(al, bl):
         return ag_gemm_device(al, bl, axis="tp", interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
+    sm = shard_map(f, mesh=mesh, in_specs=(P("tp", None), P(None, "tp")),
                        out_specs=P(None, "tp"), check_vma=False)
     return sm, (_sds((4096, 5120), jnp.bfloat16),
                 _sds((5120, 25600), jnp.bfloat16))
@@ -98,7 +99,7 @@ def _spec_gemm_rs(mesh):
     def f(al, bl):
         return gemm_rs_device(al, bl, axis="tp", interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
+    sm = shard_map(f, mesh=mesh, in_specs=(P(None, "tp"), P("tp", None)),
                        out_specs=P("tp", None), check_vma=False)
     return sm, (_sds((4096, 25600), jnp.bfloat16),
                 _sds((25600, 5120), jnp.bfloat16))
@@ -111,7 +112,7 @@ def _spec_ag_gemm_2d(mesh):
         return ag_gemm_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
                                  interpret=False)
 
-    sm = jax.shard_map(
+    sm = shard_map(
         f, mesh=mesh,
         in_specs=(P(("dcn", "ici"), None), P(None, ("dcn", "ici"))),
         out_specs=P(None, ("dcn", "ici")), check_vma=False)
@@ -128,7 +129,7 @@ def _spec_gemm_rs_2d(mesh):
         return gemm_rs_2d_device(al, bl, ici_axis="ici", dcn_axis="dcn",
                                  interpret=False)
 
-    sm = jax.shard_map(
+    sm = shard_map(
         f, mesh=mesh,
         in_specs=(P(None, ("dcn", "ici")), P(("dcn", "ici"), None)),
         out_specs=P(("dcn", "ici"), None), check_vma=False)
@@ -147,7 +148,7 @@ def _spec_ag_group_gemm(mesh):
             interpret=False)
         return up[None], state["n_dropped"][None]
 
-    sm = jax.shard_map(
+    sm = shard_map(
         f, mesh=mesh,
         in_specs=(P("tp"), P("tp"), P("tp")),
         out_specs=(P("tp"), P("tp")), check_vma=False)
@@ -166,7 +167,7 @@ def _spec_group_gemm_rs(mesh):
         return group_gemm_rs_device(act[0], w[0], capacity=cap, axis="tp",
                                     interpret=False)[None]
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("tp"), P("tp")),
+    sm = shard_map(f, mesh=mesh, in_specs=(P("tp"), P("tp")),
                        out_specs=P("tp"), check_vma=False)
     return sm, (_sds((8, E, world * cap, f_loc), jnp.bfloat16),
                 _sds((8, E, f_loc, d), jnp.bfloat16))
@@ -181,7 +182,7 @@ def _spec_sp_attention(mesh):
         return sp_ag_attention_device(q[0], k[0], v[0], axis="sp",
                                       interpret=False)[None]
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
+    sm = shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
                        out_specs=P("sp"), check_vma=False)
     x = _sds((8, H, m, dh), jnp.bfloat16)
     return sm, (x, x, x)
@@ -198,7 +199,7 @@ def _spec_sp_attention_partials(mesh):
             interpret=False)
         return out[None], lse[None]
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
+    sm = shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
                        out_specs=(P("sp"), P("sp")), check_vma=False)
     x = _sds((8, H, m, dh), jnp.bfloat16)
     return sm, (x, x, x)
@@ -213,7 +214,7 @@ def _spec_flash_decode(mesh):
         return flash_decode_device(q, k[0], v[0], axis="sp", kv_len=m_kv,
                                    interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh,
+    sm = shard_map(f, mesh=mesh,
                        in_specs=(P(), P("sp"), P("sp")),
                        out_specs=P(), check_vma=False)
     kv = _sds((8, B, Hkv, m_kv, dh), jnp.bfloat16)
@@ -231,7 +232,7 @@ def _spec_flash_prefill(mesh):
     # Single-device kernel, but the compile must still target the DETACHED
     # topology (every spec's point): shard the batch over the mesh so the
     # lowering binds to the topology's devices, not the host's backend.
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
+    sm = shard_map(f, mesh=mesh, in_specs=(P("sp"),) * 3,
                        out_specs=P("sp"), check_vma=False)
     kv = _sds((B, S, Hkv, dh), jnp.bfloat16)
     return sm, (_sds((B, L, Hq, dh), jnp.bfloat16), kv, kv)
@@ -252,7 +253,7 @@ def _spec_ep_a2a(mesh):
                                     interpret=False)
         return out[None], cnts[None]
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=(P("ep"), P("ep")),
+    sm = shard_map(f, mesh=mesh, in_specs=(P("ep"), P("ep")),
                        out_specs=(P("ep"), P("ep")), check_vma=False)
     return sm, (_sds((world, world, cap, hidden), jnp.bfloat16),
                 _sds((world, world), jnp.int32))
@@ -269,7 +270,7 @@ def _spec_ll_allgather(mesh):
                                         interpret=False)
         return out, stg[None]
 
-    sm = jax.shard_map(f, mesh=mesh,
+    sm = shard_map(f, mesh=mesh,
                        in_specs=(P("tp"), P("tp"), P()),
                        out_specs=(P(), P("tp")), check_vma=False)
     return sm, (_sds((world, m, feat), jnp.bfloat16),
@@ -285,7 +286,7 @@ def _spec_ring_allgather(mesh):
     def f(xs):
         return ring_all_gather(xs[0], axis="tp", interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
+    sm = shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
                        check_vma=False)
     return sm, (_sds((world, 512, 5120), jnp.bfloat16),)
 
@@ -298,7 +299,7 @@ def _spec_oneshot_allreduce(mesh):
     def f(xs):
         return oneshot_all_reduce(xs[0], axis="tp", interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
+    sm = shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
                        check_vma=False)
     return sm, (_sds((world, 128, 5120), jnp.bfloat16),)  # decode-M shape
 
@@ -311,7 +312,7 @@ def _spec_twoshot_allreduce(mesh):
     def f(xs):
         return twoshot_all_reduce(xs[0], axis="tp", interpret=False)
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
+    sm = shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P(),
                        check_vma=False)
     return sm, (_sds((world, 4096, 5120), jnp.bfloat16),)
 
@@ -324,7 +325,7 @@ def _spec_ring_reduce_scatter(mesh):
     def f(xs):
         return ring_reduce_scatter(xs[0], axis="tp", interpret=False)[None]
 
-    sm = jax.shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
+    sm = shard_map(f, mesh=mesh, in_specs=P("tp"), out_specs=P("tp"),
                        check_vma=False)
     return sm, (_sds((world, 4096, 5120), jnp.bfloat16),)
 

@@ -26,6 +26,7 @@ from __future__ import annotations
 import sys
 
 import jax
+from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
@@ -67,7 +68,7 @@ def main() -> int:
             out = jax.lax.psum(out, ax)
         return out
 
-    total = jax.jit(jax.shard_map(psum_all, mesh=mesh,
+    total = jax.jit(shard_map(psum_all, mesh=mesh,
                                   in_specs=P(axes if len(axes) > 1 else axes[0]),
                                   out_specs=P(axes if len(axes) > 1 else axes[0]),
                                   check_vma=False))(x)
