@@ -247,9 +247,50 @@ def main():
     # cache above only cuts wall clock.
     from triton_distributed_tpu.runtime.utils import group_profile
 
+    # --trace [--trace-dir DIR]: the unified observability arm — host span
+    # trace (Chrome trace-event JSON), Prometheus metrics snapshot, and the
+    # comm ledger (with its analytic byte self-check) land under DIR
+    # (default ./obs_trace). Orthogonal to TDT_BENCH_PROFILE (XPlane).
+    tracing = "--trace" in sys.argv
+    trace_dir = "./obs_trace"
+    if "--trace-dir" in sys.argv:
+        trace_dir = sys.argv[sys.argv.index("--trace-dir") + 1]
+
     profiling = os.environ.get("TDT_BENCH_PROFILE", "0") == "1"
     with group_profile("bench") if profiling else contextlib.nullcontext():
-        _run_benchmarks()
+        if not tracing:
+            _run_benchmarks()
+            return
+        from triton_distributed_tpu.obs import comm_ledger
+        from triton_distributed_tpu.obs import trace as obs_trace
+        from triton_distributed_tpu.obs.metrics import Metrics
+
+        obs_trace.enable()
+        try:
+            with comm_ledger.ledger(reset_first=True):
+                with obs_trace.span("bench"):
+                    result = _run_benchmarks()
+                selfcheck = comm_ledger.selfcheck()
+                ledger_snap = comm_ledger.snapshot()
+            trace_path = obs_trace.export_chrome_trace(trace_dir)
+        finally:
+            obs_trace.disable()
+        reg = Metrics()
+        reg.set_gauge(result["metric"], result["value"])
+        for k, v in result["extras"].items():
+            if isinstance(v, (int, float)):
+                reg.set_gauge(k, v, labels={"suite": "bench"})
+        with open(os.path.join(trace_dir, "metrics.prom"), "w") as f:
+            f.write(reg.to_prometheus())
+        with open(os.path.join(trace_dir, "comm_ledger.json"), "w") as f:
+            json.dump({"entries": ledger_snap, "selfcheck": selfcheck}, f,
+                      indent=2)
+        # stderr: stdout stays the bench's ONE-JSON-line contract.
+        print(json.dumps({"trace_dir": os.path.abspath(trace_dir),
+                          "chrome_trace": trace_path,
+                          "ledger_selfcheck_consistent":
+                          bool(selfcheck["consistent"])}),
+              file=sys.stderr)
 
 
 def _run_benchmarks():
@@ -686,7 +727,7 @@ def _run_benchmarks():
     except Exception as e:  # noqa: BLE001
         e2e["serve_error"] = f"{type(e).__name__}: {str(e)[:120]}"
 
-    print(json.dumps({
+    result = {
         "metric": "ag_gemm_loopback_m4096_qwen32b_tp8_ms",
         "value": round(loopback_ms, 4),
         "unit": "ms",
@@ -738,7 +779,9 @@ def _run_benchmarks():
             "mlp_vs_h800_baseline": round(BASE_MLP_MS / mlp_ms, 4),
             **e2e,
         },
-    }))
+    }
+    print(json.dumps(result))
+    return result
 
 
 def _bench_e2e_decode(model_name: str = "qwen3-1.7b", with_aot: bool = True):

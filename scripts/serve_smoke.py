@@ -28,6 +28,7 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     import jax
 
     from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs import comm_ledger
     from triton_distributed_tpu.runtime.mesh import make_mesh
     from triton_distributed_tpu.serving import BatchEngine
 
@@ -44,25 +45,32 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     deadline = start + duration_s
     next_arrival = start
     submitted = 0
-    while True:
-        now = time.monotonic()
-        if now >= deadline and next_arrival >= deadline:
-            break
-        while next_arrival <= min(now, deadline):
-            prompt = rng.integers(0, config.vocab_size,
-                                  size=int(rng.integers(3, 12))).tolist()
-            be.submit(prompt, max_new_tokens=int(rng.integers(2, 8)))
-            submitted += 1
-            next_arrival += float(rng.exponential(1.0 / rate_hz))
-        if not be.step():           # idle: sleep until the next arrival
-            time.sleep(min(0.02, max(0.0, next_arrival - time.monotonic())))
-    be.run()                        # drain in-flight + queued work
+    with comm_ledger.ledger(reset_first=True):
+        while True:
+            now = time.monotonic()
+            if now >= deadline and next_arrival >= deadline:
+                break
+            while next_arrival <= min(now, deadline):
+                prompt = rng.integers(0, config.vocab_size,
+                                      size=int(rng.integers(3, 12))).tolist()
+                be.submit(prompt, max_new_tokens=int(rng.integers(2, 8)))
+                submitted += 1
+                next_arrival += float(rng.exponential(1.0 / rate_hz))
+            if not be.step():       # idle: sleep until the next arrival
+                time.sleep(min(0.02,
+                               max(0.0, next_arrival - time.monotonic())))
+        be.run()                    # drain in-flight + queued work
 
     m = be.metrics.as_dict()
     m["requests_submitted"] = submitted
     m["wall_s"] = round(time.monotonic() - start, 3)
     m["trace_count_decode"] = be.trace_counts["decode"]
     m["trace_count_prefill"] = be.trace_counts["prefill"]
+    # Observability wiring: the comm-ledger byte-accounting cross-check
+    # (recorded bytes must equal the perf model's analytical wire bytes for
+    # AG and RS) plus whatever the serve run itself put in the ledger.
+    m["comm_ledger"] = comm_ledger.snapshot()
+    m["ledger_selfcheck"] = comm_ledger.selfcheck()
     be.pool.check_invariants()
     if be.pool.n_free != be.pool.n_blocks:
         raise RuntimeError("KV pool leaked blocks after drain")

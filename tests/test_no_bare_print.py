@@ -1,0 +1,49 @@
+"""Tier-1 enforcement of tools/check_no_bare_print.py: package code must
+route host output through ``runtime/utils.py:dist_print`` (rank-prefixed),
+never a bare ``print`` — on a multi-process pod bare prints interleave
+unprefixed lines from every host into one stream."""
+
+import importlib.util
+import pathlib
+import textwrap
+
+_REPO = pathlib.Path(__file__).parent.parent
+_TOOL = _REPO / "tools" / "check_no_bare_print.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_no_bare_print",
+                                                  _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_package_has_no_bare_prints():
+    mod = _load()
+    violations = mod.find_bare_prints(str(_REPO))
+    assert not violations, (
+        "bare print() in package code (use runtime.utils.dist_print): "
+        + ", ".join(f"{p}:{ln}" for p, ln in violations))
+
+
+def test_lint_catches_a_bare_print(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "triton_distributed_tpu" / "sub"
+    pkg.mkdir(parents=True)
+    (pkg / "bad.py").write_text(textwrap.dedent("""\
+        def f():
+            print("oops")        # real call: flagged
+            s = "print(not a call)"
+            return s
+    """))
+    found = mod.find_bare_prints(str(tmp_path))
+    assert [(p.endswith("bad.py"), ln) for p, ln in found] == [(True, 2)]
+
+
+def test_lint_allows_dist_print_home(tmp_path):
+    mod = _load()
+    pkg = tmp_path / "triton_distributed_tpu" / "runtime"
+    pkg.mkdir(parents=True)
+    (pkg / "utils.py").write_text("def dist_print(*a):\n    print(*a)\n")
+    assert mod.find_bare_prints(str(tmp_path)) == []

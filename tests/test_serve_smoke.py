@@ -22,3 +22,25 @@ def test_serve_smoke_short():
     assert m["trace_count_decode"] == 1
     assert m["trace_count_prefill"] == 1
     assert m["ttft_s_count"] == m["requests_submitted"]
+
+    # Observability wiring (obs/): latency histograms populated and
+    # self-consistent — every generated token is either a request's first
+    # (TTFT) or a successor within a residency (TBT); preemption resets the
+    # TBT chain, so re-admission first-tokens fall in neither bucket.
+    assert m["tbt_s_count"] > 0
+    assert (m["ttft_s_count"] + m["tbt_s_count"]
+            <= m["tokens_generated"])
+    assert m["tbt_s_p50"] >= 0.0 and m["ttft_s_p50"] > 0.0
+
+    # Comm-ledger byte accounting: recorded == analytical wire bytes for
+    # all-gather and reduce-scatter (executed on a TPU backend, replayed
+    # analytically where Pallas collectives cannot lower — either way the
+    # accounting path must agree with perf_model).
+    sc = m["ledger_selfcheck"]
+    assert sc["consistent"]
+    assert sc["ag_bytes"] == sc["ag_expected"] > 0
+    assert sc["rs_bytes"] == sc["rs_expected"] > 0
+    assert sc["entries"]          # the checked series are present
+    for entry in sc["entries"].values():
+        assert entry["bytes_total"] > 0
+        assert entry["calls"] + entry["traced_calls"] >= 1

@@ -1,0 +1,71 @@
+#!/usr/bin/env python
+"""Lint: no bare ``print(`` inside the ``triton_distributed_tpu`` package.
+
+On a multi-process TPU pod a bare print interleaves unprefixed lines from
+every host into one stream — undebuggable. Library code must route through
+``runtime/utils.py:dist_print`` (rank-prefixed, rank-filterable); that file
+is the single allowed home of the underlying ``print`` call.
+
+AST-based (not grep): ``print`` inside strings, comments, or docstrings is
+fine; only a real ``Name('print')`` call node is flagged. ``print``
+shadowed or aliased (``log = print``) still resolves to a Name node and is
+flagged too — redirect through ``dist_print`` instead.
+
+Exit status 0 when clean, 1 with one ``path:line`` diagnostic per
+violation otherwise. Enforced as a tier-1 test (tests/test_no_bare_print.py).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# Files (package-relative, posix-style) allowed to call print directly.
+ALLOWED = {
+    "runtime/utils.py",       # dist_print's own implementation
+}
+
+PKG = "triton_distributed_tpu"
+
+
+def find_bare_prints(root: str) -> list[tuple[str, int]]:
+    """Scan ``{root}/triton_distributed_tpu`` and return (path, lineno) of
+    every bare print call outside the allow list."""
+    pkg_dir = os.path.join(root, PKG)
+    violations: list[tuple[str, int]] = []
+    for dirpath, _dirnames, filenames in os.walk(pkg_dir):
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, pkg_dir).replace(os.sep, "/")
+            if rel in ALLOWED:
+                continue
+            with open(path, encoding="utf-8") as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError as e:
+                    violations.append((path, e.lineno or 0))
+                    continue
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Name) and node.id == "print"
+                        and isinstance(node.ctx, ast.Load)):
+                    violations.append((path, node.lineno))
+    return violations
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    root = argv[0] if argv else os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    violations = find_bare_prints(root)
+    for path, line in violations:
+        sys.stderr.write(
+            f"{path}:{line}: bare print() in package code — use "
+            "triton_distributed_tpu.runtime.utils.dist_print\n")
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
@@ -215,7 +216,18 @@ def all_gather(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
 
         return all_gather_2d(x_stacked, mesh=mesh, ici_axis=axis,
                              dcn_axis=dcn_axis, interpret=interpret)
-    return _build_ag(mesh, axis, method, interpret, x_stacked.ndim - 1)(x_stacked)
+    run = _build_ag(mesh, axis, method, interpret, x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    shard = x_stacked.nbytes // world
+    est = (pm.est_push_all_gather if method is AllGatherMethod.ALL2ALL
+           else pm.est_ring_all_gather)(shard, world)
+    return _ledger.timed(
+        lambda: run(x_stacked), "all_gather", axis=axis, world=world,
+        nbytes=pm.wire_bytes_all_gather(shard, world), method=method.value,
+        est_s=est)
 
 
 @functools.lru_cache(maxsize=None)

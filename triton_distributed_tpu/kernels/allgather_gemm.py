@@ -47,6 +47,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
@@ -952,7 +953,17 @@ def ag_gemm(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
     """
     mesh = mesh or get_default_mesh()
     config = config or AGGEMMConfig()
-    return _build_ag_gemm(mesh, axis, config, interpret)(a, b)
+    run = _build_ag_gemm(mesh, axis, config, interpret)
+    if not _ledger.enabled():
+        return run(a, b)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    world = mesh.shape[axis]
+    shard = a.nbytes // world  # the A gather is the op's only comm
+    return _ledger.timed(
+        lambda: run(a, b), "ag_gemm", axis=axis, world=world,
+        nbytes=pm.wire_bytes_all_gather(shard, world), method="overlap",
+        est_s=pm.est_push_all_gather(shard, world))
 
 
 @functools.lru_cache(maxsize=None)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
@@ -284,7 +285,18 @@ def all_reduce(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
     if method is AllReduceMethod.AUTO:
         method = choose_all_reduce_method(
             world, x_stacked.nbytes // world, x_stacked.shape[1])
-    return _build_ar(mesh, axis, method, interpret, x_stacked.ndim - 1)(x_stacked)
+    run = _build_ar(mesh, axis, method, interpret, x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    nbytes = x_stacked.nbytes // world
+    est = (pm.est_oneshot_all_reduce if method is AllReduceMethod.ONE_SHOT
+           else pm.est_twoshot_all_reduce)(nbytes, world)
+    return _ledger.timed(
+        lambda: run(x_stacked), "all_reduce", axis=axis, world=world,
+        nbytes=pm.wire_bytes_all_reduce(nbytes, world, method.value),
+        method=method.value, est_s=est)
 
 
 @functools.lru_cache(maxsize=None)

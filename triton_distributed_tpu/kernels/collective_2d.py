@@ -37,6 +37,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.kernels.allgather import ring_all_gather
 from triton_distributed_tpu.kernels.reduce_scatter import ring_reduce_scatter
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
@@ -189,8 +190,22 @@ def all_gather_2d(x_stacked, *, mesh: Mesh | None = None,
     """Stacked-convention 2D allgather: ``(W, *local)`` (device r owns
     ``[r]``, dcn-major) -> gathered ``(W*local[0], ...)`` replicated."""
     mesh = mesh or get_default_mesh()
-    return _build_ag2d(mesh, ici_axis, dcn_axis, interpret,
-                       x_stacked.ndim - 1)(x_stacked)
+    run = _build_ag2d(mesh, ici_axis, dcn_axis, interpret,
+                      x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    w_ici, w_dcn = mesh.shape[ici_axis], mesh.shape[dcn_axis]
+    world = w_ici * w_dcn
+    shard = x_stacked.nbytes // world
+    est = (pm.est_ring_all_gather(shard, w_ici)
+           + pm.est_dcn_leg(shard * w_ici, w_dcn))
+    return _ledger.timed(
+        lambda: run(x_stacked), "all_gather",
+        axis=f"{dcn_axis}x{ici_axis}", world=world,
+        nbytes=pm.wire_bytes_all_gather(shard, world), method="ring_2d",
+        est_s=est)
 
 
 def reduce_scatter_2d(x_stacked, *, mesh: Mesh | None = None,
@@ -200,9 +215,22 @@ def reduce_scatter_2d(x_stacked, *, mesh: Mesh | None = None,
     ``(W*m, ...)`` sharded so global rank r owns segment r (= sum over
     devices of their segment r)."""
     mesh = mesh or get_default_mesh()
-    return _build_rs2d(mesh, ici_axis, dcn_axis, interpret,
-                       x_stacked.ndim - 1)(x_stacked).reshape(
-                           x_stacked.shape[1:])
+    run = _build_rs2d(mesh, ici_axis, dcn_axis, interpret,
+                      x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked).reshape(x_stacked.shape[1:])
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    w_ici, w_dcn = mesh.shape[ici_axis], mesh.shape[dcn_axis]
+    world = w_ici * w_dcn
+    per_dev = x_stacked.nbytes // world
+    est = (pm.est_ring_reduce_scatter(per_dev, w_ici)
+           + pm.est_dcn_leg(per_dev // w_ici, w_dcn))
+    return _ledger.timed(
+        lambda: run(x_stacked).reshape(x_stacked.shape[1:]),
+        "reduce_scatter", axis=f"{dcn_axis}x{ici_axis}", world=world,
+        nbytes=pm.wire_bytes_reduce_scatter(per_dev, world),
+        method="ring_2d", est_s=est)
 
 
 def all_reduce_2d(x_stacked, *, mesh: Mesh | None = None,
@@ -211,5 +239,19 @@ def all_reduce_2d(x_stacked, *, mesh: Mesh | None = None,
     """Stacked-convention 2D allreduce: ``(W, m, ...)`` -> reduced
     ``(m, ...)`` replicated."""
     mesh = mesh or get_default_mesh()
-    return _build_ar2d(mesh, ici_axis, dcn_axis, interpret,
-                       x_stacked.ndim - 1)(x_stacked)
+    run = _build_ar2d(mesh, ici_axis, dcn_axis, interpret,
+                      x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    w_ici, w_dcn = mesh.shape[ici_axis], mesh.shape[dcn_axis]
+    world = w_ici * w_dcn
+    nbytes = x_stacked.nbytes // world
+    est = (pm.est_twoshot_all_reduce(nbytes, w_ici)
+           + pm.est_dcn_leg(nbytes // w_ici, w_dcn))
+    return _ledger.timed(
+        lambda: run(x_stacked), "all_reduce",
+        axis=f"{dcn_axis}x{ici_axis}", world=world,
+        nbytes=pm.wire_bytes_all_reduce(nbytes, world, "two_shot"),
+        method="ring_2d", est_s=est)

@@ -48,6 +48,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
@@ -195,6 +196,19 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
         if pay.shape[0] != world or pay.shape[1] != ctx.capacity:
             raise ValueError(f"payload {pay.shape} != (world={world}, "
                              f"capacity={ctx.capacity}, ...)")
+    if _ledger.enabled():
+        # Device-level entry: fires at trace time (counts compilations).
+        # Bytes are the capacity-shaped upper bound — occupancy-predicated
+        # chunk sends move less at runtime; the static bound is what the
+        # compiled program can move per execution.
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        per_dev = sum(p.nbytes for p in payloads)
+        _ledger.record_traced(
+            "ep_all_to_all", axis=ctx.axis, world=world,
+            nbytes=pm.wire_bytes_all_to_all(per_dev, world),
+            method=direction,
+            est_s=pm.est_push_all_gather(per_dev // world, world))
     _check_payload_alignment(payloads, resolve_interpret(interpret))
     n = len(payloads)
     ch = ctx.chunk_rows
@@ -247,8 +261,19 @@ def all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
     single = not isinstance(payloads, (tuple, list))
     payloads = (payloads,) if single else tuple(payloads)
     ndims = tuple(p.ndim for p in payloads)
-    out, counts = _build_a2a(mesh, ctx, ndims, interpret)(
-        payloads, send_counts)
+    run = _build_a2a(mesh, ctx, ndims, interpret)
+    if not _ledger.enabled():
+        out, counts = run(payloads, send_counts)
+        return (out[0] if single else out), counts
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    world = mesh.shape[ctx.axis]
+    per_dev = sum(p.nbytes // world for p in payloads)
+    out, counts = _ledger.timed(
+        lambda: run(payloads, send_counts), "ep_all_to_all",
+        axis=ctx.axis, world=world,
+        nbytes=pm.wire_bytes_all_to_all(per_dev, world), method="stacked",
+        est_s=pm.est_push_all_gather(per_dev // world, world))
     return (out[0] if single else out), counts
 
 

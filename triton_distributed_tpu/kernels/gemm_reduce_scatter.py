@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
@@ -420,7 +421,19 @@ def gemm_rs(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
     """
     mesh = mesh or get_default_mesh()
     config = config or GEMMRSConfig()
-    return _build_gemm_rs(mesh, axis, config, interpret)(a, b)
+    run = _build_gemm_rs(mesh, axis, config, interpret)
+    if not _ledger.enabled():
+        return run(a, b)
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    world = mesh.shape[axis]
+    # Each device scatters its full (M, N) partial product.
+    out_itemsize = jnp.promote_types(a.dtype, b.dtype).itemsize
+    per_dev = a.shape[0] * b.shape[1] * out_itemsize
+    return _ledger.timed(
+        lambda: run(a, b), "gemm_rs", axis=axis, world=world,
+        nbytes=pm.wire_bytes_reduce_scatter(per_dev, world),
+        method="overlap", est_s=pm.est_oneshot_reduce_scatter(per_dev, world))
 
 
 @functools.lru_cache(maxsize=None)

@@ -46,6 +46,7 @@ from jax.experimental.pallas import tpu as pltpu
 from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 from triton_distributed_tpu.runtime import symm
@@ -156,9 +157,22 @@ def ll_all_gather(x_stacked, staging_ws: symm.SymmetricWorkspace, epoch, *,
     ``staging_ws.array`` in place (donated and re-bound) so successive
     calls reuse the same physical staging buffer."""
     mesh = mesh or get_default_mesh()
-    out, new_staging = _build_ll_ag(mesh, axis, interpret,
-                                    x_stacked.ndim - 1)(
-        x_stacked, staging_ws.array, jnp.asarray(epoch, jnp.int32))
+    run = _build_ll_ag(mesh, axis, interpret, x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        out, new_staging = run(x_stacked, staging_ws.array,
+                               jnp.asarray(epoch, jnp.int32))
+        staging_ws.array = new_staging
+        return out
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    world = mesh.shape[axis]
+    shard = x_stacked.nbytes // world
+    out, new_staging = _ledger.timed(
+        lambda: run(x_stacked, staging_ws.array,
+                    jnp.asarray(epoch, jnp.int32)),
+        "ll_all_gather", axis=axis, world=world,
+        nbytes=pm.wire_bytes_all_gather(shard, world), method="ll",
+        est_s=pm.est_ll_all_gather(shard, world))
     staging_ws.array = new_staging
     return out
 

@@ -52,6 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
 from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
 
@@ -169,6 +170,15 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
         up = jnp.einsum("ecd,edf->ecf", grid_x, w_up_local,
                         preferred_element_type=jnp.float32)
         return up.astype(out_dtype), state
+
+    if _ledger.enabled():
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        _ledger.record_traced(
+            "moe_ag_group_gemm", axis=axis, world=world,
+            nbytes=pm.wire_bytes_all_gather(grid_x.nbytes, world),
+            method="overlap",
+            est_s=pm.est_push_all_gather(grid_x.nbytes, world))
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -328,6 +338,17 @@ def group_gemm_rs_device(act, w_down_local, *, capacity: int,
     if world == 1:
         return jnp.einsum("ecf,efd->ecd", act, w_down_local,
                           preferred_element_type=jnp.float32).astype(out_dtype)
+
+    if _ledger.enabled():
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        # Each device scatters its (E, world*cap, d) partial down-product.
+        per_dev = E * rows * d * out_dtype.itemsize
+        _ledger.record_traced(
+            "moe_group_gemm_rs", axis=axis, world=world,
+            nbytes=pm.wire_bytes_reduce_scatter(per_dev, world),
+            method="overlap",
+            est_s=pm.est_oneshot_reduce_scatter(per_dev, world))
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
     grid_spec = pltpu.PrefetchScalarGridSpec(

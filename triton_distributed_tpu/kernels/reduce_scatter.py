@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
@@ -225,8 +226,19 @@ def reduce_scatter(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
     if method not in ("oneshot", "ring"):
         raise ValueError(f"unknown reduce_scatter method {method!r}: "
                          f"expected 'auto', 'oneshot', 'ring', or 'ring_2d'")
-    return _build_rs(mesh, axis, method, interpret, x_stacked.ndim - 1)(
-        x_stacked).reshape(x_stacked.shape[1:])
+    run = _build_rs(mesh, axis, method, interpret, x_stacked.ndim - 1)
+    if not _ledger.enabled():
+        return run(x_stacked).reshape(x_stacked.shape[1:])
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    per_dev = x_stacked.nbytes // world
+    est = (pm.est_oneshot_reduce_scatter if method == "oneshot"
+           else pm.est_ring_reduce_scatter)(per_dev, world)
+    return _ledger.timed(
+        lambda: run(x_stacked).reshape(x_stacked.shape[1:]),
+        "reduce_scatter", axis=axis, world=world,
+        nbytes=pm.wire_bytes_reduce_scatter(per_dev, world), method=method,
+        est_s=est)
 
 
 @functools.lru_cache(maxsize=None)

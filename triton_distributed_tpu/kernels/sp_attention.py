@@ -38,6 +38,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
 from triton_distributed_tpu.kernels.allgather import ring_all_gather
+from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
 _NEG_INF = -1e30
@@ -166,6 +167,15 @@ def sp_ag_attention_device(q_local, k_local, v_local, *, axis: str = "sp",
         return _single_device_attn(q_local, k_local, v_local, causal=causal,
                                    scale=scale)
     m_kv = k_local.shape[1]
+
+    if world > 1 and _ledger.enabled():
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        shard = k_local.nbytes + v_local.nbytes  # the KV gather is the comm
+        _ledger.record_traced(
+            "sp_ag_attention", axis=axis, world=world,
+            nbytes=pm.wire_bytes_all_gather(shard, world), method="overlap",
+            est_s=pm.est_push_all_gather(shard, world))
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)
     row0 = (me * m if row_offset is None
@@ -876,6 +886,15 @@ def flash_decode_device(q, k_cache_local, v_cache_local, *, axis: str = "sp",
             f"make_ll_staging((B*H, decode_partial_feat(dh)), ...) — the "
             f"packed (out, lse) rows are lane-padded")
     packed = _pack_decode_partial(out_local, lse_local, dh)
+    if _ledger.enabled():
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        _ledger.record_traced(
+            "flash_decode", axis=axis, world=world,
+            nbytes=pm.wire_bytes_all_gather(packed.nbytes, world),
+            method="ll" if ll_staging is not None else "ring",
+            est_s=(pm.est_ll_all_gather if ll_staging is not None
+                   else pm.est_ring_all_gather)(packed.nbytes, world))
     if ll_staging is not None:
         from triton_distributed_tpu.kernels.ll_allgather import (
             ll_all_gather_device,

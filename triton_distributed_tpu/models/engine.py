@@ -27,6 +27,7 @@ from triton_distributed_tpu.models.config import ModelConfig
 from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.models.qwen import Qwen3
 from triton_distributed_tpu.models.sampling import sample_token
+from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 
@@ -181,11 +182,14 @@ class Engine:
 
     def prefill(self, input_ids, kv: KVCache):
         """input_ids: (B, L) -> (logits (B, V), kv)."""
-        return self._run_step(self.prefill_mode, input_ids, kv)
+        with _trace.span("prefill", mode=self.prefill_mode,
+                         tokens=int(input_ids.shape[0] * input_ids.shape[1])):
+            return self._run_step(self.prefill_mode, input_ids, kv)
 
     def decode_step(self, token, kv: KVCache):
         """token: (B,) -> (logits (B, V), kv)."""
-        return self._run_step(self.decode_mode, token[:, None], kv)
+        with _trace.span("decode_step", mode=self.decode_mode):
+            return self._run_step(self.decode_mode, token[:, None], kv)
 
     def serve(self, input_ids, gen_len: int, key=None):
         """Generate ``gen_len`` tokens after the prompt.
@@ -206,18 +210,20 @@ class Engine:
             key = jax.random.PRNGKey(0)  # stochastic sampling needs a key
         kv = self.new_cache(B)
 
-        logits, kv = self.prefill(input_ids, kv)
-        key, sub = (None, None) if key is None else jax.random.split(key)
-        tok = sample_token(logits, sub, temperature=self.temperature,
-                           top_p=self.top_p)
-        out = [tok]
-        for _ in range(gen_len - 1):
-            logits, kv = self.decode_step(tok, kv)
+        with _trace.span("serve", batch=B, prompt_len=L0, gen_len=gen_len):
+            logits, kv = self.prefill(input_ids, kv)
             key, sub = (None, None) if key is None else jax.random.split(key)
             tok = sample_token(logits, sub, temperature=self.temperature,
                                top_p=self.top_p)
-            out.append(tok)
-        return jnp.stack(out, axis=1)
+            out = [tok]
+            for _ in range(gen_len - 1):
+                logits, kv = self.decode_step(tok, kv)
+                key, sub = ((None, None) if key is None
+                            else jax.random.split(key))
+                tok = sample_token(logits, sub, temperature=self.temperature,
+                                   top_p=self.top_p)
+                out.append(tok)
+            return jnp.stack(out, axis=1)
 
     # -- scanned generation (whole decode loop in ONE executable) -----------
 
@@ -275,5 +281,7 @@ class Engine:
                 f"prompt ({L0}) + gen_len ({gen_len}) exceeds max_length "
                 f"({self.max_length})")
         run = self._serve_scanned_fn(gen_len, L0)
-        return run(self.params, input_ids, self.new_cache(B),
-                   jax.random.PRNGKey(0) if key is None else key)
+        with _trace.span("serve_scanned", batch=B, prompt_len=L0,
+                         gen_len=gen_len):
+            return run(self.params, input_ids, self.new_cache(B),
+                       jax.random.PRNGKey(0) if key is None else key)

@@ -1,0 +1,47 @@
+"""Unified observability layer: trace spans, metrics, and the comm ledger.
+
+One substrate, three views, threaded through every layer of the stack:
+
+  obs.trace        host-side span tracer (nested spans, monotonic + wall
+                   time, per-process ring buffer). Spans emit
+                   ``jax.profiler.TraceAnnotation`` scopes so they land
+                   inside XProf captures; the ring buffer exports merged
+                   per-rank Chrome trace-event JSON for Perfetto. Also owns
+                   ``group_profile`` (the XProf capture context re-exported
+                   via ``runtime/utils.py``).
+  obs.metrics      label-aware counters / gauges / histograms with flat
+                   dict, delta-snapshot, and Prometheus text exposition.
+                   ``serving.metrics`` is a re-export shim over this.
+  obs.comm_ledger  per-(collective, axis) ledger of wire bytes, call
+                   counts, and achieved-vs-``perf_model``-estimated
+                   latency, fed by every collective entry point in
+                   ``kernels/``. Near-zero-overhead no-op when disabled.
+
+Everything here is disabled by default and costs one attribute check per
+call site when off — the serving/bench hot paths carry the hooks
+permanently. Design note: docs/observability.md.
+"""
+
+from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
+from triton_distributed_tpu.obs import trace  # noqa: F401
+from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
+    CommLedger,
+    LedgerEntry,
+)
+from triton_distributed_tpu.obs.metrics import (  # noqa: F401
+    Histogram,
+    Metrics,
+    parse_prometheus,
+)
+from triton_distributed_tpu.obs.trace import (  # noqa: F401
+    SpanRecord,
+    Tracer,
+    group_profile,
+    merge_chrome_traces,
+)
+
+__all__ = [
+    "CommLedger", "LedgerEntry", "Histogram", "Metrics", "SpanRecord",
+    "Tracer", "comm_ledger", "group_profile", "merge_chrome_traces",
+    "parse_prometheus", "trace",
+]

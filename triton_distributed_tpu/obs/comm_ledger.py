@@ -1,0 +1,282 @@
+"""Comm ledger: who moved how many bytes over which axis, and at what cost.
+
+Every collective entry point in ``kernels/`` reports here when the ledger
+is enabled: wire bytes (the analytical per-device byte count from
+``runtime/perf_model.py`` — the same model that drives method dispatch),
+call counts, the model's estimated latency, and — for host-level wrappers,
+where a real wall clock exists — achieved latency. The straggler question
+("which collective, on which rank, is slow") then reads straight off the
+``achieved vs estimated`` ratio per (collective, axis) without attaching
+XProf.
+
+Two recording paths, because kernels run in two regimes:
+
+- ``timed(fn, ...)`` wraps a HOST-level wrapper call (``all_gather(...)``
+  etc.): runs ``fn``, blocks until ready, records wall time next to the
+  estimate. Blocking is deliberate — the enabled ledger is a measurement
+  mode; the disabled path never blocks, never computes bytes, and costs
+  one attribute check.
+- ``record_traced(...)`` marks a DEVICE-level entry point (``*_device``
+  functions composed inside ``shard_map``/``jit``): it fires at TRACE
+  time, so its count is compilations, not executions — still exactly what
+  "is this kernel in the compiled program, and how many bytes does each
+  execution move" needs. Records are flagged ``traced`` so the two kinds
+  never mix.
+
+The ledger is process-global (like the tracer): collectives are called
+from layers, engines, and benches that share no object graph.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+
+import jax
+
+
+@dataclasses.dataclass
+class LedgerEntry:
+    """Aggregate for one (collective, method, axis, world) series."""
+
+    collective: str
+    method: str
+    axis: str
+    world: int
+    calls: int = 0            # host-level executions
+    traced_calls: int = 0     # device-level trace-time records
+    bytes_total: float = 0.0  # analytical wire bytes, summed over calls
+    est_s_total: float = 0.0  # perf_model estimated seconds, summed
+    wall_s_total: float = 0.0 # achieved seconds (host-level calls only)
+    wall_samples: int = 0
+
+    @property
+    def key(self) -> str:
+        return (f"{self.collective}[{self.method or 'auto'},"
+                f"axis={self.axis},world={self.world}]")
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        if self.wall_samples and self.est_s_total:
+            # achieved / estimated: ~1 means the perf model is honest;
+            # >>1 on one rank but not others names the straggler.
+            d["achieved_over_est"] = round(
+                (self.wall_s_total / self.wall_samples)
+                / (self.est_s_total / max(self.calls + self.traced_calls, 1)),
+                4)
+        return d
+
+
+class CommLedger:
+    def __init__(self):
+        self.enabled = False
+        self._entries: dict[tuple, LedgerEntry] = {}
+        self._lock = threading.Lock()
+
+    # -- state --------------------------------------------------------------
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def disable(self) -> None:
+        self.enabled = False
+
+    def reset(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def entries(self) -> list[LedgerEntry]:
+        return list(self._entries.values())
+
+    def get(self, collective: str) -> list[LedgerEntry]:
+        return [e for e in self._entries.values()
+                if e.collective == collective]
+
+    def bytes_for(self, collective: str) -> float:
+        return sum(e.bytes_total for e in self.get(collective))
+
+    def snapshot(self) -> dict[str, dict]:
+        """``{series_key: aggregate dict}`` — JSON-ready."""
+        with self._lock:
+            return {e.key: e.as_dict() for e in self._entries.values()}
+
+    # -- recording ----------------------------------------------------------
+
+    def record(self, collective: str, *, axis: str, world: int,
+               nbytes: float, method: str = "", est_s: float | None = None,
+               wall_s: float | None = None, traced: bool = False) -> None:
+        if not self.enabled:
+            return
+        key = (collective, method, axis, world)
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None:
+                e = self._entries[key] = LedgerEntry(
+                    collective=collective, method=method, axis=axis,
+                    world=world)
+            if traced:
+                e.traced_calls += 1
+            else:
+                e.calls += 1
+            e.bytes_total += float(nbytes)
+            if est_s is not None:
+                e.est_s_total += float(est_s)
+            if wall_s is not None:
+                e.wall_s_total += float(wall_s)
+                e.wall_samples += 1
+
+    def record_traced(self, collective: str, *, axis: str, world: int,
+                      nbytes: float, method: str = "",
+                      est_s: float | None = None) -> None:
+        """Trace-time record for device-level entry points (see module
+        docstring: counts compilations, not executions)."""
+        self.record(collective, axis=axis, world=world, nbytes=nbytes,
+                    method=method, est_s=est_s, traced=True)
+
+    def timed(self, fn, collective: str, *, axis: str, world: int,
+              nbytes: float, method: str = "",
+              est_s: float | None = None):
+        """Run ``fn()`` and record wall time (blocking on the result). If
+        ``fn`` turns out to be running under a trace (its output holds
+        tracers), falls back to a traced record — trace-time wall clocks
+        measure compilation, not the collective."""
+        t0 = time.perf_counter()
+        out = fn()
+        if any(isinstance(leaf, jax.core.Tracer)
+               for leaf in jax.tree_util.tree_leaves(out)):
+            self.record_traced(collective, axis=axis, world=world,
+                               nbytes=nbytes, method=method, est_s=est_s)
+            return out
+        jax.block_until_ready(out)
+        self.record(collective, axis=axis, world=world, nbytes=nbytes,
+                    method=method, est_s=est_s,
+                    wall_s=time.perf_counter() - t0)
+        return out
+
+
+_LEDGER = CommLedger()
+
+
+def get_ledger() -> CommLedger:
+    return _LEDGER
+
+
+def enabled() -> bool:
+    return _LEDGER.enabled
+
+
+def enable() -> None:
+    _LEDGER.enable()
+
+
+def disable() -> None:
+    _LEDGER.disable()
+
+
+def reset() -> None:
+    _LEDGER.reset()
+
+
+def snapshot() -> dict[str, dict]:
+    return _LEDGER.snapshot()
+
+
+def record(collective: str, **kw) -> None:
+    _LEDGER.record(collective, **kw)
+
+
+def record_traced(collective: str, **kw) -> None:
+    _LEDGER.record_traced(collective, **kw)
+
+
+def timed(fn, collective: str, **kw):
+    return _LEDGER.timed(fn, collective, **kw)
+
+
+@contextlib.contextmanager
+def ledger(reset_first: bool = False):
+    """Scoped enable (restores the prior enabled state)."""
+    if reset_first:
+        _LEDGER.reset()
+    prior = _LEDGER.enabled
+    _LEDGER.enable()
+    try:
+        yield _LEDGER
+    finally:
+        _LEDGER.enabled = prior
+
+
+def selfcheck(mesh=None, axis: str = "tp") -> dict:
+    """Byte-accounting cross-check: run one all-gather and one
+    reduce-scatter through the instrumented host wrappers and compare the
+    ledger's byte counters against the perf model's analytical wire-byte
+    counts — the acceptance invariant for the ledger (recorded == analytic
+    for at least AG and RS).
+
+    Where the backend cannot lower the Pallas collectives (a CPU host
+    without the TPU interpreter), the call is replayed analytically through
+    ``record()`` with the same wire-byte formula, so the check still
+    verifies the ledger's accounting path end to end; ``*_mode`` reports
+    which regime ran. The caller's ledger state (enabled flag AND
+    accumulated entries) is saved and restored around the check.
+    """
+    # Lazy imports: kernels/ imports this module at its top level.
+    import jax.numpy as jnp
+
+    from triton_distributed_tpu.kernels.allgather import all_gather
+    from triton_distributed_tpu.kernels.reduce_scatter import reduce_scatter
+    from triton_distributed_tpu.runtime import perf_model as pm
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+
+    if mesh is None:
+        world = len(jax.devices())
+        mesh = make_mesh({axis: world}, devices=jax.devices()[:world],
+                         set_default=False)
+    world = mesh.shape[axis]
+
+    x_ag = jnp.ones((world, 4, 128), jnp.float32)
+    ag_expected = pm.wire_bytes_all_gather(x_ag.nbytes // world, world)
+    x_rs = jnp.ones((world, world * 4, 128), jnp.float32)
+    rs_expected = pm.wire_bytes_reduce_scatter(x_rs.nbytes // world, world)
+
+    prior_entries = dict(_LEDGER._entries)
+    try:
+        with ledger(reset_first=True) as led:
+            try:
+                jax.block_until_ready(all_gather(x_ag, mesh=mesh, axis=axis))
+                ag_mode = "executed"
+            except Exception:  # noqa: BLE001 — no Pallas lowering here
+                record("all_gather", axis=axis, world=world,
+                       nbytes=ag_expected, method="analytical")
+                ag_mode = "analytical"
+            try:
+                jax.block_until_ready(
+                    reduce_scatter(x_rs, mesh=mesh, axis=axis))
+                rs_mode = "executed"
+            except Exception:  # noqa: BLE001
+                record("reduce_scatter", axis=axis, world=world,
+                       nbytes=rs_expected, method="analytical")
+                rs_mode = "analytical"
+            ag_bytes = led.bytes_for("all_gather")
+            rs_bytes = led.bytes_for("reduce_scatter")
+            entries = led.snapshot()
+    finally:
+        _LEDGER._entries = prior_entries
+    return {
+        "world": world,
+        "ag_bytes": ag_bytes,
+        "ag_expected": float(ag_expected),
+        "ag_mode": ag_mode,
+        "rs_bytes": rs_bytes,
+        "rs_expected": float(rs_expected),
+        "rs_mode": rs_mode,
+        "consistent": (ag_bytes == float(ag_expected)
+                       and rs_bytes == float(rs_expected)),
+        "entries": entries,
+    }

@@ -1,0 +1,251 @@
+"""Shared metrics registry: labeled counters, gauges, histograms.
+
+The promotion of the serving-local ``serving/metrics.py`` registry into
+the observability layer (``serving.metrics`` re-exports from here, so
+existing imports keep working). Still dependency-free — plain Python
+numbers in, plain dicts or Prometheus text out — because the TPU image
+carries no metrics library and the consumers are bench.py's one-JSON-line
+contract and log scrapers.
+
+Additions over the serving-local version:
+  labels     every record method takes ``labels={...}``; label sets are
+             separate series of the same metric (Prometheus semantics).
+  exposition ``to_prometheus()`` emits text exposition format (counters as
+             ``<name>_total``, histograms as summaries with quantile
+             series) for scrape endpoints or file snapshots.
+  deltas     ``snapshot()`` captures a point-in-time cursor; ``delta(s)``
+             returns only what changed since — counter increments and
+             histogram stats over the NEW observations only (per-step and
+             per-window telemetry without resetting the registry).
+  safety     ``as_dict()`` raises on key collisions instead of silently
+             overwriting (see docstring there).
+
+Schema (``as_dict()`` keys — the flat contract bench.py and
+scripts/serve_smoke.py consume):
+  counters   ``<name>``                               -> float
+  gauges     ``<name>``                               -> float
+  histograms ``<name>_{count,mean,p50,p95,max}``      -> float
+  labeled series append ``{k=v,...}`` to ``<name>`` (sorted by key), e.g.
+  ``bytes{collective=all_gather}`` or ``lat_s{axis=tp}_p50``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import re
+
+
+@dataclasses.dataclass
+class Histogram:
+    """Exact-sample histogram (serving loads here are 1e2-1e5 observations;
+    a streaming sketch would be premature)."""
+
+    samples: list = dataclasses.field(default_factory=list)
+
+    def observe(self, value: float) -> None:
+        self.samples.append(float(value))
+
+    @property
+    def count(self) -> int:
+        return len(self.samples)
+
+    @property
+    def sum(self) -> float:
+        return float(sum(self.samples))
+
+    @property
+    def mean(self) -> float:
+        return (sum(self.samples) / len(self.samples)) if self.samples else 0.0
+
+    def percentile(self, p: float) -> float:
+        """Nearest-rank percentile, p in [0, 100]."""
+        if not self.samples:
+            return 0.0
+        s = sorted(self.samples)
+        rank = max(0, min(len(s) - 1, math.ceil(p / 100.0 * len(s)) - 1))
+        return s[rank]
+
+
+def _series_key(name: str, labels: dict | None) -> str:
+    """Flat series name: ``name`` or ``name{k=v,...}`` (keys sorted, so one
+    label set is one series regardless of dict order)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    return f"{name}{{{inner}}}"
+
+
+_SERIES_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
+_PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _split_series(key: str) -> tuple[str, dict]:
+    m = _SERIES_RE.match(key)
+    labels = {}
+    if m.group("labels"):
+        for part in m.group("labels").split(","):
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return m.group("name"), labels
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_NAME_RE.sub("_", name)
+
+
+def _prom_labels(labels: dict, extra: dict | None = None) -> str:
+    merged = dict(labels)
+    if extra:
+        merged.update(extra)
+    if not merged:
+        return ""
+    inner = ",".join(f'{_prom_name(k)}="{v}"' for k, v in
+                     sorted(merged.items()))
+    return "{" + inner + "}"
+
+
+class Metrics:
+    """Named counters / gauges / histograms, created on first touch."""
+
+    def __init__(self):
+        self.counters: dict[str, float] = {}
+        self.gauges: dict[str, float] = {}
+        self.histograms: dict[str, Histogram] = {}
+
+    def inc(self, name: str, amount: float = 1.0, *,
+            labels: dict | None = None) -> None:
+        key = _series_key(name, labels)
+        self.counters[key] = self.counters.get(key, 0.0) + amount
+
+    def set_gauge(self, name: str, value: float, *,
+                  labels: dict | None = None) -> None:
+        self.gauges[_series_key(name, labels)] = float(value)
+
+    def observe(self, name: str, value: float, *,
+                labels: dict | None = None) -> None:
+        self.histograms.setdefault(_series_key(name, labels),
+                                   Histogram()).observe(value)
+
+    # -- flat export --------------------------------------------------------
+
+    def as_dict(self) -> dict[str, float]:
+        """Flatten to the schema documented in the module docstring.
+
+        Raises ``ValueError`` on a key collision — e.g. a counter named
+        ``x_count`` next to a histogram named ``x`` — instead of the
+        silent last-writer-wins overwrite the serving-local version had
+        (a scraper reading the collided key got whichever family flattened
+        last, with no error anywhere).
+        """
+        out: dict[str, float] = {}
+
+        def put(key: str, value: float, family: str):
+            if key in out:
+                raise ValueError(
+                    f"metrics key collision on {key!r} (while flattening "
+                    f"{family}): rename one of the colliding metrics")
+            out[key] = value
+
+        for k, v in self.counters.items():
+            put(k, v, "counters")
+        for k, v in self.gauges.items():
+            put(k, v, "gauges")
+        for name, h in self.histograms.items():
+            put(f"{name}_count", float(h.count), f"histogram {name!r}")
+            put(f"{name}_mean", h.mean, f"histogram {name!r}")
+            put(f"{name}_p50", h.percentile(50), f"histogram {name!r}")
+            put(f"{name}_p95", h.percentile(95), f"histogram {name!r}")
+            put(f"{name}_max", max(h.samples) if h.samples else 0.0,
+                f"histogram {name!r}")
+        return out
+
+    # -- delta snapshots ----------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Opaque cursor for ``delta()``: current counter values and
+        histogram observation counts."""
+        return {
+            "counters": dict(self.counters),
+            "gauges": dict(self.gauges),
+            "hist_counts": {k: h.count for k, h in self.histograms.items()},
+        }
+
+    def delta(self, since: dict | None = None) -> dict[str, float]:
+        """Flat dict of CHANGES since ``since`` (a ``snapshot()`` result;
+        None = since registry creation): counter increments, current gauge
+        values, and histogram stats computed over only the observations
+        made after the snapshot."""
+        since = since or {"counters": {}, "gauges": {}, "hist_counts": {}}
+        out: dict[str, float] = {}
+        for k, v in self.counters.items():
+            d = v - since["counters"].get(k, 0.0)
+            if d:
+                out[k] = d
+        for k, v in self.gauges.items():
+            if v != since["gauges"].get(k):
+                out[k] = v
+        for name, h in self.histograms.items():
+            new = Histogram(h.samples[since["hist_counts"].get(name, 0):])
+            if not new.count:
+                continue
+            out[f"{name}_count"] = float(new.count)
+            out[f"{name}_mean"] = new.mean
+            out[f"{name}_p50"] = new.percentile(50)
+            out[f"{name}_p95"] = new.percentile(95)
+            out[f"{name}_max"] = max(new.samples)
+        return out
+
+    # -- Prometheus text exposition -----------------------------------------
+
+    def to_prometheus(self) -> str:
+        """Text exposition (format 0.0.4): counters as ``<name>_total``,
+        gauges verbatim, histograms as summaries (p50/p95 quantile series
+        plus ``_sum``/``_count``). Invalid name characters sanitize to
+        ``_``; labels carry through."""
+        lines: list[str] = []
+        seen_types: set[str] = set()
+
+        def header(name: str, kind: str):
+            if name not in seen_types:
+                seen_types.add(name)
+                lines.append(f"# TYPE {name} {kind}")
+
+        for key, v in sorted(self.counters.items()):
+            name, labels = _split_series(key)
+            pname = _prom_name(name) + "_total"
+            header(pname, "counter")
+            lines.append(f"{pname}{_prom_labels(labels)} {v!r}")
+        for key, v in sorted(self.gauges.items()):
+            name, labels = _split_series(key)
+            pname = _prom_name(name)
+            header(pname, "gauge")
+            lines.append(f"{pname}{_prom_labels(labels)} {v!r}")
+        for key, h in sorted(self.histograms.items()):
+            name, labels = _split_series(key)
+            pname = _prom_name(name)
+            header(pname, "summary")
+            for q, p in (("0.5", 50), ("0.95", 95)):
+                lines.append(
+                    f"{pname}{_prom_labels(labels, {'quantile': q})} "
+                    f"{h.percentile(p)!r}")
+            lines.append(f"{pname}_sum{_prom_labels(labels)} {h.sum!r}")
+            lines.append(f"{pname}_count{_prom_labels(labels)} {h.count}")
+        return "\n".join(lines) + "\n"
+
+
+def parse_prometheus(text: str) -> dict[str, float]:
+    """Parse text exposition back to ``{series: value}`` (comment lines
+    dropped, label order normalized) — the round-trip check for tests and
+    for scraping a snapshot file without a client library."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        series, _, value = line.rpartition(" ")
+        name, labels = _split_series(series)
+        # Normalize quoted label values + ordering to the _series_key form.
+        labels = {k: v.strip('"') for k, v in labels.items()}
+        out[_series_key(name, labels)] = float(value)
+    return out

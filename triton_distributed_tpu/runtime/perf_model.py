@@ -164,6 +164,44 @@ def est_dcn_leg(nbytes: int, num_slices: int,
     return (num_slices - 1) * (nbytes / hw.dcn_bw + hw.dcn_lat)
 
 
+# ---------------------------------------------------------------------------
+# Analytical wire bytes (per device). The comm ledger (obs/comm_ledger.py)
+# records these next to achieved latency, so "ledger bytes" and "model
+# bytes" are one definition — tests assert the ledger totals against these
+# exact functions.
+# ---------------------------------------------------------------------------
+
+
+def wire_bytes_all_gather(shard_nbytes: int, world: int) -> int:
+    """Bytes each device moves over the wire in an allgather of one
+    ``shard_nbytes`` shard: it sends (ring) or receives (push) the other
+    world-1 shards exactly once either way."""
+    return (world - 1) * shard_nbytes
+
+
+def wire_bytes_reduce_scatter(per_dev_nbytes: int, world: int) -> int:
+    """Bytes each device sends in a reduce-scatter of its full
+    ``per_dev_nbytes`` contribution: world-1 chunks of nbytes/world (ring
+    and one-shot move the same bytes; they differ in latency/HBM cost)."""
+    return (world - 1) * per_dev_nbytes // world
+
+
+def wire_bytes_all_reduce(nbytes: int, world: int,
+                          method: str = "one_shot") -> int:
+    """Bytes each device sends in an allreduce of ``nbytes``: one-shot
+    pushes the full buffer to every peer; two-shot is ring RS + ring AG,
+    each moving (world-1)/world of the buffer."""
+    if method in ("one_shot", "oneshot"):
+        return (world - 1) * nbytes
+    return 2 * (world - 1) * nbytes // world
+
+
+def wire_bytes_all_to_all(per_dev_nbytes: int, world: int) -> int:
+    """Bytes each device sends in an all-to-all of its ``(world, cap, ...)``
+    slot buffer (``per_dev_nbytes`` total): every slot but its own."""
+    return (world - 1) * per_dev_nbytes // world
+
+
 def est_matmul(m: int, k: int, n: int, itemsize: int = 2,
                hw: Hardware | None = None, mfu: float = 0.85) -> float:
     """Roofline matmul time: max(MXU at ``mfu``, HBM traffic). The SOL

@@ -100,24 +100,12 @@ def assert_allclose(actual, expected, *, atol=None, rtol=None, msg=""):
         )
 
 
-@contextlib.contextmanager
-def group_profile(name: str = "trace", *, enabled: bool = True, dir: str = "/tmp/tdtpu_trace"):
-    """Profiling context (analog of reference ``group_profile`` utils.py:500).
-
-    The reference merges per-rank chrome traces by hand; on TPU
-    ``jax.profiler`` already captures every local device into one XPlane trace,
-    so the cross-rank merge reduces to each process writing
-    ``{dir}/{name}/p{process_index}``, viewable together in XProf/Perfetto.
-    """
-    if not enabled:
-        yield
-        return
-    path = f"{dir}/{name}/p{jax.process_index()}"
-    jax.profiler.start_trace(path)
-    try:
-        yield
-    finally:
-        jax.profiler.stop_trace()
+# Re-export: the implementation moved into the observability layer
+# (obs/trace.py), hardened over this seed version — the trace directory is
+# created up front (``start_trace`` assumes it exists) and nested/double
+# entry degrades to a no-op scope instead of ``start_trace`` raising.
+# Signature and default dir are unchanged for existing callers.
+from triton_distributed_tpu.obs.trace import group_profile  # noqa: E402,F401
 
 
 def straggler_delay(x, steps, *, size: int = 8):

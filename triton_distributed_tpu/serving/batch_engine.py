@@ -41,6 +41,7 @@ import numpy as np
 
 from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.models.sampling import sample_token
+from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
@@ -55,6 +56,7 @@ class _Slot:
     ctx: list[int]          # prompt + pre-preemption output: what to prefill
     offset: int = 0         # tokens written into the pool so far
     last_tok: int = 0       # pending decode input (valid once offset>=len(ctx))
+    last_token_t: float | None = None   # wall of previous emitted token (TBT)
 
     @property
     def prefilling(self) -> bool:
@@ -165,6 +167,8 @@ class BatchEngine:
                       max_new_tokens=max_new_tokens, priority=priority,
                       submit_t=time.monotonic())
         self.scheduler.submit(req)
+        _trace.async_begin("request", req_id, prompt_len=len(prompt),
+                           max_new_tokens=max_new_tokens)
         return req_id
 
     def _admit(self):
@@ -183,6 +187,13 @@ class BatchEngine:
                                              ctx=ctx)
             self._admit_seq += 1
             self.metrics.inc("requests_admitted")
+            if req.n_preemptions == 0:
+                # First admission only: re-admissions after preemption would
+                # double-count the scheduler wait.
+                self.metrics.observe("queue_wait_s",
+                                     time.monotonic() - req.submit_t)
+            _trace.instant("admit", req=req.req_id,
+                           ctx_len=len(ctx), readmit=req.n_preemptions > 0)
 
     def _preempt(self, idx: int):
         s = self._slots[idx]
@@ -191,6 +202,8 @@ class BatchEngine:
         self.scheduler.requeue(s.req)
         self._slots[idx] = None
         self.metrics.inc("preemptions")
+        _trace.instant("preempt", req=s.req.req_id, slot=idx,
+                       progress=s.offset)
 
     def _ensure_or_preempt(self, idx: int) -> bool:
         """Grow slot ``idx``'s table for its next token write, evicting
@@ -214,15 +227,25 @@ class BatchEngine:
         self._finished[s.req.req_id] = s.req
         self.metrics.inc("requests_completed")
         self.metrics.observe("e2e_latency_s", s.req.finish_t - s.req.submit_t)
+        _trace.async_end("request", s.req.req_id,
+                         tokens=len(s.req.output),
+                         preemptions=s.req.n_preemptions)
 
     def _record_token(self, s: _Slot, tok: int):
         s.req.output.append(tok)
         s.last_tok = tok
         self.metrics.inc("tokens_generated")
+        now = time.monotonic()
         if s.req.first_token_t is None:
-            s.req.first_token_t = time.monotonic()
-            self.metrics.observe("ttft_s",
-                                 s.req.first_token_t - s.req.submit_t)
+            s.req.first_token_t = now
+            self.metrics.observe("ttft_s", now - s.req.submit_t)
+            _trace.instant("first_token", req=s.req.req_id)
+        elif s.last_token_t is not None:
+            # Inter-token latency within one residency; the slot-local
+            # timestamp resets on preemption so the requeue gap lands in
+            # queue_wait/preemption accounting, not TBT.
+            self.metrics.observe("tbt_s", now - s.last_token_t)
+        s.last_token_t = now
 
     # -- iteration ----------------------------------------------------------
 
@@ -264,11 +287,14 @@ class BatchEngine:
                        np.int32)
         offsets, tables, mask = self._operands()
         st = self.pool.state
-        nxt, k, v = self._decode_step(self.engine.params, jnp.asarray(tok),
-                                      st.k, st.v, offsets, tables, mask,
-                                      self._next_key())
+        with _trace.span("decode_step",
+                         active=int(sum(s is not None for s in self._slots))):
+            nxt, k, v = self._decode_step(self.engine.params,
+                                          jnp.asarray(tok),
+                                          st.k, st.v, offsets, tables, mask,
+                                          self._next_key())
+            nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
-        nxt = np.asarray(nxt)
         self.metrics.inc("decode_steps")
         for i, s in enumerate(self._slots):
             if s is None:
@@ -294,12 +320,16 @@ class BatchEngine:
                 seq_lens[i] = 1
         offsets, tables, mask = self._operands()
         st = self.pool.state
-        nxt, k, v = self._mixed_step(self.engine.params, jnp.asarray(ids),
-                                     st.k, st.v, offsets, tables, mask,
-                                     jnp.asarray(seq_lens),
-                                     self._next_key())
+        with _trace.span("mixed_step",
+                         prefill_rows=int((seq_lens > 1).sum()),
+                         active=int(sum(s is not None for s in self._slots))):
+            nxt, k, v = self._mixed_step(self.engine.params,
+                                         jnp.asarray(ids),
+                                         st.k, st.v, offsets, tables, mask,
+                                         jnp.asarray(seq_lens),
+                                         self._next_key())
+            nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
-        nxt = np.asarray(nxt)
         self.metrics.inc("prefill_steps")
         for i, s in enumerate(self._slots):
             if s is None:

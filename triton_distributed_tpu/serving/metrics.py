@@ -1,74 +1,22 @@
-"""Serving metrics: counters, gauges, histograms.
+"""Serving metrics — re-export shim over ``obs/metrics.py``.
 
-Dependency-free observability for the continuous-batching stack
-(``serving/batch_engine.py``): the scheduler and engine record into a
-``Metrics`` registry; ``as_dict()`` flattens everything into plain Python
-numbers so ``bench.py``'s synthetic-load arm (and any log scraper) can
-consume it without a metrics library in the image.
+The registry was promoted into the unified observability layer
+(``triton_distributed_tpu.obs.metrics``) where it gained labels, delta
+snapshots, and Prometheus text exposition; every serving-side import path
+(``serving.metrics.Metrics`` / ``Histogram``) keeps working unchanged, and
+``as_dict()`` keeps the documented flat schema:
 
-Schema (``as_dict()`` keys):
   counters   ``<name>`` -> float                (monotonic totals)
   gauges     ``<name>`` -> float                (last set value)
   histograms ``<name>_{count,mean,p50,p95,max}`` -> float
+
+(now collision-checked: a counter/gauge name that collides with a
+histogram's flattened keys raises instead of silently overwriting).
 """
 
-from __future__ import annotations
+from triton_distributed_tpu.obs.metrics import (  # noqa: F401
+    Histogram,
+    Metrics,
+)
 
-import dataclasses
-import math
-
-
-@dataclasses.dataclass
-class Histogram:
-    """Exact-sample histogram (serving loads here are 1e2-1e5 observations;
-    a streaming sketch would be premature)."""
-
-    samples: list = dataclasses.field(default_factory=list)
-
-    def observe(self, value: float) -> None:
-        self.samples.append(float(value))
-
-    @property
-    def count(self) -> int:
-        return len(self.samples)
-
-    @property
-    def mean(self) -> float:
-        return (sum(self.samples) / len(self.samples)) if self.samples else 0.0
-
-    def percentile(self, p: float) -> float:
-        """Nearest-rank percentile, p in [0, 100]."""
-        if not self.samples:
-            return 0.0
-        s = sorted(self.samples)
-        rank = max(0, min(len(s) - 1, math.ceil(p / 100.0 * len(s)) - 1))
-        return s[rank]
-
-
-class Metrics:
-    """Named counters / gauges / histograms, created on first touch."""
-
-    def __init__(self):
-        self.counters: dict[str, float] = {}
-        self.gauges: dict[str, float] = {}
-        self.histograms: dict[str, Histogram] = {}
-
-    def inc(self, name: str, amount: float = 1.0) -> None:
-        self.counters[name] = self.counters.get(name, 0.0) + amount
-
-    def set_gauge(self, name: str, value: float) -> None:
-        self.gauges[name] = float(value)
-
-    def observe(self, name: str, value: float) -> None:
-        self.histograms.setdefault(name, Histogram()).observe(value)
-
-    def as_dict(self) -> dict[str, float]:
-        out: dict[str, float] = dict(self.counters)
-        out.update(self.gauges)
-        for name, h in self.histograms.items():
-            out[f"{name}_count"] = float(h.count)
-            out[f"{name}_mean"] = h.mean
-            out[f"{name}_p50"] = h.percentile(50)
-            out[f"{name}_p95"] = h.percentile(95)
-            out[f"{name}_max"] = max(h.samples) if h.samples else 0.0
-        return out
+__all__ = ["Histogram", "Metrics"]

@@ -31,6 +31,8 @@ import dataclasses
 import heapq
 import itertools
 
+from triton_distributed_tpu.obs import trace as _trace
+
 
 @dataclasses.dataclass
 class Request:
@@ -99,6 +101,10 @@ class Scheduler:
                 break
             budget -= need
             admitted.append(self.pop())
+        if admitted and _trace.enabled():
+            _trace.instant("schedule_admit", admitted=len(admitted),
+                           waiting=len(self._heap), free_slots=free_slots,
+                           blocks_left=budget)
         return admitted
 
     @staticmethod

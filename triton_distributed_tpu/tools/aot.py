@@ -42,6 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from triton_distributed_tpu.runtime.utils import dist_print
+
 
 def _sds(shape, dtype):
     return jax.ShapeDtypeStruct(tuple(shape), jnp.dtype(dtype))
@@ -476,11 +478,12 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         try:
             aot_compile_flagship(name, topology=args.topology)
-            print(f"{name}: ok ({time.perf_counter() - t0:.1f}s)", flush=True)
+            dist_print(f"{name}: ok ({time.perf_counter() - t0:.1f}s)",
+                       flush=True)
         except Exception as e:  # noqa: BLE001 — report and continue
             failed.append(name)
             msg = str(e).split("\n")[0][:300]
-            print(f"{name}: FAIL {type(e).__name__}: {msg}", flush=True)
+            dist_print(f"{name}: FAIL {type(e).__name__}: {msg}", flush=True)
     return 1 if failed else 0
 
 

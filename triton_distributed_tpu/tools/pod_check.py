@@ -30,9 +30,11 @@ from triton_distributed_tpu.runtime.compat import shard_map
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
+from triton_distributed_tpu.runtime.utils import dist_print
+
 
 def log(msg: str) -> None:
-    print(f"[pod_check] p{jax.process_index()}: {msg}", flush=True)
+    dist_print(f"[pod_check] {msg}", flush=True)
 
 
 def main() -> int:
