@@ -240,6 +240,25 @@ def main():
             print(json.dumps({f"{_bench_tag(model)}_error":
                               f"{type(e).__name__}: {str(e)[:120]}"}))
         return
+
+    # --chaos [--chaos-model NAME] [--chaos-seed N]: the resilience arm —
+    # the serving trace under an installed default_chaos_plan (injected
+    # transient step/allocator errors + NaN-poisoned logit rows). Reports
+    # GOODPUT (tokens of successful requests only), failure accounting,
+    # and recovery latency. Same ONE-JSON-line stdout contract.
+    if "--chaos" in sys.argv:
+        model = "qwen3-1.7b"
+        if "--chaos-model" in sys.argv:
+            model = sys.argv[sys.argv.index("--chaos-model") + 1]
+        seed = 0
+        if "--chaos-seed" in sys.argv:
+            seed = int(sys.argv[sys.argv.index("--chaos-seed") + 1])
+        try:
+            print(json.dumps(_bench_serve_chaos(model, seed=seed)))
+        except Exception as e:  # noqa: BLE001
+            print(json.dumps({"chaos_error":
+                              f"{type(e).__name__}: {str(e)[:160]}"}))
+        return
     # TDT_BENCH_PROFILE=1 wraps the measurement in the group_profile
     # context (runtime/utils.py — the reference's cross-rank trace-merge
     # analog); the XPlane trace lands under /tmp/tdtpu_trace. Compile time
@@ -891,6 +910,78 @@ def _bench_serve(model_name: str = "qwen3-1.7b") -> dict:
         "serve_retraces": int(be.trace_counts["decode"]
                               + be.trace_counts["prefill"] - 2),
     }
+
+
+def _bench_serve_chaos(model_name: str = "qwen3-1.7b", *,
+                       seed: int = 0) -> dict:
+    """Chaos serving arm (``--chaos``): the same request mix as
+    ``_bench_serve``, driven closed-loop under an installed
+    ``default_chaos_plan`` — injected transient step/allocator errors
+    (retried with backoff), NaN-poisoned logit rows (quarantined), and a
+    watchdog over every step. The numbers that matter:
+
+      goodput      tokens/s counting SUCCESSFUL requests only — what the
+                   degraded server still delivers
+      recovery     first-failure -> success latency through the retry
+                   path (p50/p95)
+      failed       requests quarantined with an error status (the batch
+                   never crashes; ``run()`` completes and accounts for
+                   every submitted request)
+      retraces     still 0: fault handling is host-side slot churn, the
+                   compiled steps never re-specialize
+    """
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import (
+        Watchdog,
+        default_chaos_plan,
+        faults,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    config = ModelConfig.from_name(model_name, max_length=512)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="dist",
+                    key=jax.random.PRNGKey(0))
+    be = BatchEngine(engine, n_slots=8, n_blocks=8 * 10, block_size=16,
+                     prefill_chunk=64, max_seq_len=512,
+                     admission_pressure=0.05)
+    be.attach_watchdog(Watchdog(), step_deadline_s=120.0)
+    rng = np.random.default_rng(0)   # request mix fixed; seed moves FAULTS
+    n_req = 24
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(32, 128))).tolist()
+               for _ in range(n_req)]
+    gens = rng.integers(16, 48, size=n_req)
+    for p, g in zip(prompts, gens):
+        be.submit(p, max_new_tokens=int(g))
+
+    chaos = default_chaos_plan(seed)
+    t0 = time.perf_counter()
+    with faults.plan(chaos):
+        ok = be.run(max_steps=20000)
+    wall_s = time.perf_counter() - t0
+    be.pool.check_invariants()
+    m = be.metrics.as_dict()
+    good_tokens = sum(len(t) for t in ok.values())
+    out = {
+        "chaos_seed": seed,
+        "chaos_goodput_tokens_per_s": round(good_tokens / wall_s, 1),
+        "chaos_requests_ok": len(ok),
+        "chaos_requests_failed": len(be.failed),
+        "chaos_faults_injected": chaos.n_fired,
+        "chaos_step_retries": int(m.get("step_retries", 0)),
+        "chaos_retraces": int(be.trace_counts["decode"]
+                              + be.trace_counts["prefill"] - 2),
+    }
+    if "recovery_s_p50" in m:
+        out["chaos_recovery_p50_ms"] = round(m["recovery_s_p50"] * 1e3, 2)
+        out["chaos_recovery_p95_ms"] = round(m["recovery_s_p95"] * 1e3, 2)
+    assert len(ok) + len(be.failed) == n_req, "requests unaccounted for"
+    return out
 
 
 def _bench_e2e_subprocess(model_name: str) -> dict:

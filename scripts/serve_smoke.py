@@ -10,6 +10,14 @@ that slot churn (arrivals, completions, preemptions) is data, not shape.
 Runs on CPU (``JAX_PLATFORMS=cpu scripts/serve_smoke.py``) or TPU alike.
 ``main()`` is importable; tests/test_serve_smoke.py runs it with a short
 duration as a tier-1 test.
+
+``--chaos`` additionally installs the stock fault plan
+(``resilience.default_chaos_plan``: transient step/allocator errors plus
+NaN-poisoned logit rows) with aggressive rates and asserts GRACEFUL
+DEGRADATION instead of full completion: the engine must finish the run
+(no crash, no retrace), every submitted request must end as either
+completed or quarantined-with-error, at least one request of each kind
+must exist, and the pool must still drain clean.
 """
 
 from __future__ import annotations
@@ -22,13 +30,24 @@ import numpy as np
 
 
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
-         n_blocks: int | None = 12, seed: int = 0) -> dict:
+         n_blocks: int | None = 12, seed: int = 0, chaos: bool = False
+         ) -> dict:
     """Run the load, return the metrics dict. Raises RuntimeError on any
-    retrace beyond the first compile of each step kind."""
+    retrace beyond the first compile of each step kind; with ``chaos``,
+    also on any violation of the graceful-degradation contract."""
+    import contextlib
+
     import jax
 
     from triton_distributed_tpu.models import Engine, ModelConfig
     from triton_distributed_tpu.obs import comm_ledger
+    from triton_distributed_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        RetryPolicy,
+        Watchdog,
+        faults,
+    )
     from triton_distributed_tpu.runtime.mesh import make_mesh
     from triton_distributed_tpu.serving import BatchEngine
 
@@ -37,15 +56,38 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
     # n_blocks below full residency so sustained load also exercises
     # admission control and preemption-by-recompute, not just steady state.
+    # The chaos run gets a deep retry budget: at p=0.1 per step, 6 retries
+    # put per-step exhaustion at 1e-7 — the smoke asserts degradation,
+    # not luck.
     be = BatchEngine(engine, n_slots=n_slots, n_blocks=n_blocks,
-                     block_size=4, prefill_chunk=8)
+                     block_size=4, prefill_chunk=8,
+                     retry=RetryPolicy(retries=6, base_delay_s=0.001)
+                     if chaos else None)
+
+    plan_ctx = contextlib.nullcontext()
+    if chaos:
+        # Hotter than default_chaos_plan so a SHORT smoke reliably sees
+        # both outcomes: a near-certain NaN quarantine early on plus
+        # frequent (but always retryable) transient errors.
+        plan_ctx = faults.plan(FaultPlan([
+            FaultSpec(site="engine.decode", kind="error", p=0.1,
+                      start_after=1),
+            FaultSpec(site="pool.ensure", kind="error", p=0.05,
+                      start_after=2),
+            # No max_fires: a firing that lands on an EMPTY slot 0
+            # quarantines nobody, so keep rolling until it bites. Only
+            # slot 0 is ever poisoned — slots 1.. always have survivors.
+            FaultSpec(site="engine.decode", kind="nan", p=0.35, row=0,
+                      start_after=2),
+        ], seed=seed))
+        be.attach_watchdog(Watchdog(), step_deadline_s=60.0)
 
     rng = np.random.default_rng(seed)
     start = time.monotonic()
     deadline = start + duration_s
     next_arrival = start
     submitted = 0
-    with comm_ledger.ledger(reset_first=True):
+    with comm_ledger.ledger(reset_first=True), plan_ctx:
         while True:
             now = time.monotonic()
             if now >= deadline and next_arrival >= deadline:
@@ -74,9 +116,27 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     be.pool.check_invariants()
     if be.pool.n_free != be.pool.n_blocks:
         raise RuntimeError("KV pool leaked blocks after drain")
-    if m["requests_completed"] != submitted:
+    completed = int(m["requests_completed"])
+    failed = int(m.get("requests_failed", 0))
+    m["requests_failed"] = failed
+    if completed + failed != submitted:
         raise RuntimeError(
-            f"drain incomplete: {m['requests_completed']}/{submitted}")
+            f"drain incomplete: {completed} ok + {failed} failed "
+            f"!= {submitted} submitted")
+    if chaos:
+        # Graceful degradation, both halves: the faults actually hurt
+        # someone (>=1 quarantined with an error attached) AND the batch
+        # survived it (>=1 completed normally).
+        if not failed:
+            raise RuntimeError("chaos run quarantined nothing — fault "
+                               "plan never bit")
+        if not completed:
+            raise RuntimeError("chaos run completed nothing — degradation "
+                               "was not graceful")
+        if any(r.error is None for r in be.failed.values()):
+            raise RuntimeError("quarantined request missing error status")
+    elif failed:
+        raise RuntimeError(f"{failed} requests failed without chaos")
     for kind, n in be.trace_counts.items():
         if n > 1:
             raise RuntimeError(
@@ -91,9 +151,13 @@ if __name__ == "__main__":
     ap.add_argument("--rate", type=float, default=4.0,
                     help="mean arrivals per second (Poisson)")
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--chaos", action="store_true",
+                    help="install the fault plan; assert graceful "
+                         "degradation (>=1 quarantined, >=1 completed)")
     args = ap.parse_args()
     try:
-        metrics = main(args.duration, rate_hz=args.rate, seed=args.seed)
+        metrics = main(args.duration, rate_hz=args.rate, seed=args.seed,
+                       chaos=args.chaos)
     except RuntimeError as e:
         print(f"FAIL: {e}")
         raise SystemExit(1)

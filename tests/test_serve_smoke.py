@@ -44,3 +44,21 @@ def test_serve_smoke_short():
     for entry in sc["entries"].values():
         assert entry["bytes_total"] > 0
         assert entry["calls"] + entry["traced_calls"] >= 1
+
+
+def test_serve_smoke_chaos():
+    """The --chaos mode's graceful-degradation contract: the engine rides
+    out injected transient errors and NaN-poisoned rows, finishing with
+    at least one quarantined AND at least one successful request, full
+    accounting, a drained pool, and zero retraces (main() raises on any
+    violation — this test exists to run that contract under tier 1)."""
+    m = _load().main(3.0, rate_hz=6.0, seed=0, chaos=True)
+    assert m["requests_submitted"] > 0
+    assert m["requests_failed"] >= 1
+    assert m["requests_completed"] >= 1
+    assert (m["requests_completed"] + m["requests_failed"]
+            == m["requests_submitted"])
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+    # the fault plane actually exercised the retry path
+    assert m.get("step_retries", 0) + m.get("alloc_retries", 0) > 0

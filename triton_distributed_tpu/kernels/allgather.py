@@ -217,7 +217,7 @@ def all_gather(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
         return all_gather_2d(x_stacked, mesh=mesh, ici_axis=axis,
                              dcn_axis=dcn_axis, interpret=interpret)
     run = _build_ag(mesh, axis, method, interpret, x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked)
     from triton_distributed_tpu.runtime import perf_model as pm
 

@@ -954,7 +954,7 @@ def ag_gemm(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
     mesh = mesh or get_default_mesh()
     config = config or AGGEMMConfig()
     run = _build_ag_gemm(mesh, axis, config, interpret)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(a, b)
     from triton_distributed_tpu.runtime import perf_model as pm
 

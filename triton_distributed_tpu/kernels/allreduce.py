@@ -286,7 +286,7 @@ def all_reduce(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
         method = choose_all_reduce_method(
             world, x_stacked.nbytes // world, x_stacked.shape[1])
     run = _build_ar(mesh, axis, method, interpret, x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked)
     from triton_distributed_tpu.runtime import perf_model as pm
 

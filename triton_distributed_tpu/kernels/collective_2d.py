@@ -192,7 +192,7 @@ def all_gather_2d(x_stacked, *, mesh: Mesh | None = None,
     mesh = mesh or get_default_mesh()
     run = _build_ag2d(mesh, ici_axis, dcn_axis, interpret,
                       x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked)
     from triton_distributed_tpu.runtime import perf_model as pm
 
@@ -217,7 +217,7 @@ def reduce_scatter_2d(x_stacked, *, mesh: Mesh | None = None,
     mesh = mesh or get_default_mesh()
     run = _build_rs2d(mesh, ici_axis, dcn_axis, interpret,
                       x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked).reshape(x_stacked.shape[1:])
     from triton_distributed_tpu.runtime import perf_model as pm
 
@@ -241,7 +241,7 @@ def all_reduce_2d(x_stacked, *, mesh: Mesh | None = None,
     mesh = mesh or get_default_mesh()
     run = _build_ar2d(mesh, ici_axis, dcn_axis, interpret,
                       x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked)
     from triton_distributed_tpu.runtime import perf_model as pm
 

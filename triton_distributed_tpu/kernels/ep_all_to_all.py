@@ -262,7 +262,7 @@ def all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
     payloads = (payloads,) if single else tuple(payloads)
     ndims = tuple(p.ndim for p in payloads)
     run = _build_a2a(mesh, ctx, ndims, interpret)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         out, counts = run(payloads, send_counts)
         return (out[0] if single else out), counts
     from triton_distributed_tpu.runtime import perf_model as pm

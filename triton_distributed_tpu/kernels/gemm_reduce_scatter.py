@@ -422,7 +422,7 @@ def gemm_rs(a, b, *, mesh: Mesh | None = None, axis: str = "tp",
     mesh = mesh or get_default_mesh()
     config = config or GEMMRSConfig()
     run = _build_gemm_rs(mesh, axis, config, interpret)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(a, b)
     from triton_distributed_tpu.runtime import perf_model as pm
 

@@ -158,7 +158,7 @@ def ll_all_gather(x_stacked, staging_ws: symm.SymmetricWorkspace, epoch, *,
     calls reuse the same physical staging buffer."""
     mesh = mesh or get_default_mesh()
     run = _build_ll_ag(mesh, axis, interpret, x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         out, new_staging = run(x_stacked, staging_ws.array,
                                jnp.asarray(epoch, jnp.int32))
         staging_ws.array = new_staging

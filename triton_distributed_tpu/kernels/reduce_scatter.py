@@ -227,7 +227,7 @@ def reduce_scatter(x_stacked, *, mesh: Mesh | None = None, axis: str = "tp",
         raise ValueError(f"unknown reduce_scatter method {method!r}: "
                          f"expected 'auto', 'oneshot', 'ring', or 'ring_2d'")
     run = _build_rs(mesh, axis, method, interpret, x_stacked.ndim - 1)
-    if not _ledger.enabled():
+    if not _ledger.active():  # ledger recording or resilience hooks
         return run(x_stacked).reshape(x_stacked.shape[1:])
     from triton_distributed_tpu.runtime import perf_model as pm
 

@@ -13,6 +13,16 @@ import jax
 import jax.numpy as jnp
 
 
+def finite_logits_mask(logits):
+    """logits: (B, V) -> (B,) bool, True where every logit is finite.
+
+    The NaN/Inf guard the serving steps compile in unconditionally
+    (resilience/guards.py): a tiny always-present reduction, so toggling
+    the guard ACTION on the host never changes a compiled shape — the
+    SPMD-safety requirement for failure handling on a TPU mesh."""
+    return jnp.all(jnp.isfinite(logits), axis=-1)
+
+
 def sample_token(logits, key=None, *, temperature: float = 0.0,
                  top_p: float = 1.0):
     """logits: (B, V) fp32 -> (B,) int32 sampled token ids."""

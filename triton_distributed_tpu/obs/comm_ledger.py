@@ -25,6 +25,14 @@ Two recording paths, because kernels run in two regimes:
 
 The ledger is process-global (like the tracer): collectives are called
 from layers, engines, and benches that share no object graph.
+
+Resilience hooks: the ``timed()`` host wrappers are ALSO the resilience
+layer's instrumentation point for collectives (``resilience.install_hooks``
+registers a fault-injection pre-call and a watchdog-deadline context via
+``set_resilience_hooks``; ``active()`` tells the kernel call sites to route
+through ``timed()`` whenever the ledger is enabled OR a hook is installed).
+The hooks live here as plain module attributes so obs/ keeps zero imports
+from resilience/ and the disabled path stays one attribute check.
 """
 
 from __future__ import annotations
@@ -145,15 +153,28 @@ class CommLedger:
         """Run ``fn()`` and record wall time (blocking on the result). If
         ``fn`` turns out to be running under a trace (its output holds
         tracers), falls back to a traced record — trace-time wall clocks
-        measure compilation, not the collective."""
+        measure compilation, not the collective.
+
+        When resilience hooks are installed (``set_resilience_hooks``),
+        the pre-call hook fires first (fault injection: may raise
+        ``TransientFault`` or sleep) and the execution runs under the
+        watchdog-deadline context — this is the ``comm.<collective>``
+        fault/watchdog site."""
+        if _PRE_CALL_HOOK is not None:
+            _PRE_CALL_HOOK(collective, axis=axis, world=world)
+        ctx = (_DEADLINE_HOOK(collective) if _DEADLINE_HOOK is not None
+               else contextlib.nullcontext())
         t0 = time.perf_counter()
-        out = fn()
-        if any(isinstance(leaf, jax.core.Tracer)
-               for leaf in jax.tree_util.tree_leaves(out)):
-            self.record_traced(collective, axis=axis, world=world,
-                               nbytes=nbytes, method=method, est_s=est_s)
-            return out
-        jax.block_until_ready(out)
+        with ctx:
+            out = fn()
+            if any(isinstance(leaf, jax.core.Tracer)
+                   for leaf in jax.tree_util.tree_leaves(out)):
+                self.record_traced(collective, axis=axis, world=world,
+                                   nbytes=nbytes, method=method, est_s=est_s)
+                return out
+            # The deadline covers the blocking wait too — a hung collective
+            # hangs HERE, not at dispatch.
+            jax.block_until_ready(out)
         self.record(collective, axis=axis, world=world, nbytes=nbytes,
                     method=method, est_s=est_s,
                     wall_s=time.perf_counter() - t0)
@@ -162,6 +183,20 @@ class CommLedger:
 
 _LEDGER = CommLedger()
 
+# Resilience hooks (installed via set_resilience_hooks, normally by
+# triton_distributed_tpu.resilience.install_hooks). Both default None: the
+# hot path pays one module-attribute check.
+_PRE_CALL_HOOK = None   # fn(collective, *, axis, world) — may raise / sleep
+_DEADLINE_HOOK = None   # fn(collective) -> context manager
+
+
+def set_resilience_hooks(*, pre_call=None, deadline=None) -> None:
+    """Install (or clear, with None) the fault-injection pre-call and
+    watchdog-deadline hooks applied inside every ``timed()`` wrapper."""
+    global _PRE_CALL_HOOK, _DEADLINE_HOOK
+    _PRE_CALL_HOOK = pre_call
+    _DEADLINE_HOOK = deadline
+
 
 def get_ledger() -> CommLedger:
     return _LEDGER
@@ -169,6 +204,13 @@ def get_ledger() -> CommLedger:
 
 def enabled() -> bool:
     return _LEDGER.enabled
+
+
+def active() -> bool:
+    """Should collective call sites route through ``timed()``? True when
+    the ledger records OR a resilience hook needs to observe the call."""
+    return (_LEDGER.enabled or _PRE_CALL_HOOK is not None
+            or _DEADLINE_HOOK is not None)
 
 
 def enable() -> None:

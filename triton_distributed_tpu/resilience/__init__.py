@@ -1,0 +1,94 @@
+"""Resilience layer: deterministic fault injection, watchdogs, graceful
+degradation.
+
+The serving path (serving/, models/) gains production error boundaries
+without giving up its SPMD guarantees — failure handling is host-side slot
+churn and the compiled step shapes never change. Three planes, each off by
+default behind a single attribute check (the obs-layer pattern):
+
+  resilience.faults    ``FaultPlan`` — seeded, deterministic fault
+                       injection at named host sites (scheduler admission,
+                       KV-pool allocation, engine steps, the comm-ledger
+                       ``timed()`` collective wrappers): transient errors,
+                       injected latency (slow-rank), NaN payloads.
+  resilience.watchdog  host deadlines on blocking sections + a serving
+                       heartbeat; breach dumps a diagnostic snapshot
+                       (metrics + comm ledger + in-flight request table)
+                       before raising ``WatchdogTimeout``.
+  resilience.guards    NaN/Inf logit guards (compiled into the batched
+                       steps as an always-on finite mask; quarantine is
+                       host-side) and ``RetryPolicy`` — bounded
+                       exponential backoff for transient step failures,
+                       with recovery-latency reporting.
+
+``install_hooks()`` wires faults + watchdog into ``obs.comm_ledger`` so
+every host-level collective wrapper in kernels/ becomes a fault site
+(``comm.<collective>``) and runs under a watchdog deadline — no kernel
+code changes. Design note: docs/resilience.md.
+"""
+
+from triton_distributed_tpu.resilience import faults  # noqa: F401
+from triton_distributed_tpu.resilience import guards  # noqa: F401
+from triton_distributed_tpu.resilience import watchdog  # noqa: F401
+from triton_distributed_tpu.resilience.faults import (  # noqa: F401
+    FaultEvent,
+    FaultPlan,
+    FaultSpec,
+    TransientFault,
+    default_chaos_plan,
+)
+from triton_distributed_tpu.resilience.guards import (  # noqa: F401
+    QuarantineError,
+    RetryPolicy,
+    bad_rows,
+)
+from triton_distributed_tpu.resilience.watchdog import (  # noqa: F401
+    Heartbeat,
+    Watchdog,
+    WatchdogTimeout,
+)
+
+
+def install_hooks(*, plan: FaultPlan | None = None,
+                  watchdog: Watchdog | None = None,
+                  collective_deadline_s: float | None = None) -> None:
+    """Wire the resilience planes into ``obs.comm_ledger`` (and install
+    ``plan`` globally if given): every host-level collective wrapper then
+    fires the ``comm.<collective>`` fault site and runs under
+    ``watchdog.deadline`` when a deadline is set. Call
+    ``uninstall_hooks()`` to restore the bare ledger."""
+    from triton_distributed_tpu.obs import comm_ledger
+
+    if plan is not None:
+        faults.install(plan)
+
+    pre_call = None
+    if plan is not None or faults.active():
+        def pre_call(collective, *, axis, world):  # noqa: ARG001
+            faults.fire(f"comm.{collective}")
+
+    deadline = None
+    if watchdog is not None and collective_deadline_s is not None:
+        def deadline(collective):
+            return watchdog.deadline(f"comm.{collective}",
+                                     collective_deadline_s)
+
+    comm_ledger.set_resilience_hooks(pre_call=pre_call, deadline=deadline)
+
+
+def uninstall_hooks(*, keep_plan: bool = False) -> None:
+    """Remove the comm-ledger hooks (and the global fault plan unless
+    ``keep_plan``)."""
+    from triton_distributed_tpu.obs import comm_ledger
+
+    comm_ledger.set_resilience_hooks(pre_call=None, deadline=None)
+    if not keep_plan:
+        faults.uninstall()
+
+
+__all__ = [
+    "FaultEvent", "FaultPlan", "FaultSpec", "Heartbeat", "QuarantineError",
+    "RetryPolicy", "TransientFault", "Watchdog", "WatchdogTimeout",
+    "bad_rows", "default_chaos_plan", "faults", "guards", "install_hooks",
+    "uninstall_hooks", "watchdog",
+]

@@ -1,0 +1,217 @@
+"""Deterministic fault-injection plane (chaos testing for the serving path).
+
+A ``FaultPlan`` perturbs NAMED SITES in the host-side control flow with
+three fault kinds:
+
+  error   raise ``TransientFault`` (a retryable failure — the injected
+          analog of a flaky DMA submit or an allocator hiccup)
+  delay   ``time.sleep`` at the site (slow-rank / straggler simulation)
+  nan     return a payload-corruption directive the call site applies to
+          its DEVICE data (the batch engine adds NaN into one slot's
+          logits row through an always-present zero operand, so injection
+          never changes a compiled shape)
+
+Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
+
+  sched.admit          Scheduler admission (serving/batch_engine._admit)
+  pool.ensure          KV-pool block allocation (serving/kv_pool.ensure)
+  engine.decode        the batched decode step (serving/batch_engine)
+  engine.prefill       the batched mixed/prefill step
+  comm.<collective>    every host-level collective wrapper in kernels/
+                       (via the ``obs.comm_ledger.timed`` hook)
+
+Determinism is the whole point: every decision comes from a per-(spec,
+site) ``random.Random`` stream seeded by ``(plan.seed, spec index, site)``
+and a per-site call counter — the same seed against the same call sequence
+fires the bit-identical fault sequence (``plan.log`` is the witness;
+tests/test_resilience.py asserts it). Wall-clock never enters a decision.
+
+Off by default behind a single attribute check, like the ledger and the
+tracer: hot call sites guard with ``if faults._PLAN is not None`` and pay
+one module-attribute load when no plan is installed.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import random
+import time
+
+
+class TransientFault(RuntimeError):
+    """A retryable failure (injected, or raised by call sites that want
+    the bounded-backoff retry path in ``resilience.guards``)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One perturbation rule. ``site`` matches exactly, or as a prefix when
+    it ends with ``*`` (``comm.*`` hits every collective)."""
+
+    site: str
+    kind: str                   # "error" | "delay" | "nan"
+    p: float = 1.0              # per-call fire probability
+    delay_s: float = 0.0        # sleep length for kind="delay"
+    row: int | None = None      # target slot row for kind="nan" (None = 0)
+    start_after: int = 0        # skip the first N matching calls
+    max_fires: int | None = None  # stop firing after N fires (None = inf)
+
+    def __post_init__(self):
+        if self.kind not in ("error", "delay", "nan"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if not 0.0 <= self.p <= 1.0:
+            raise ValueError(f"fault probability {self.p} not in [0, 1]")
+
+    def matches(self, site: str) -> bool:
+        if self.site.endswith("*"):
+            return site.startswith(self.site[:-1])
+        return site == self.site
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultEvent:
+    """One fired fault — ``plan.log`` entries (the determinism witness)."""
+
+    site: str
+    call_index: int             # per-site call counter at fire time
+    kind: str
+    spec_index: int
+    row: int | None = None
+
+
+class FaultPlan:
+    """Seeded set of ``FaultSpec`` rules + the per-site call counters.
+
+    ``fire(site)`` advances the site's counter, evaluates every matching
+    spec in order, and applies at most one ERROR (raises) after applying
+    any delays; a matched ``nan`` spec is RETURNED as a directive
+    ``("nan", row)`` for the call site to apply to its payload. All fired
+    events append to ``plan.log``.
+    """
+
+    def __init__(self, specs, *, seed: int = 0):
+        self.specs = list(specs)
+        self.seed = seed
+        self.log: list[FaultEvent] = []
+        self._calls: dict[str, int] = {}
+        self._fires: dict[int, int] = {}
+        self._rngs: dict[tuple[int, str], random.Random] = {}
+
+    def _rng(self, spec_index: int, site: str) -> random.Random:
+        key = (spec_index, site)
+        rng = self._rngs.get(key)
+        if rng is None:
+            rng = self._rngs[key] = random.Random(
+                f"{self.seed}\x1f{spec_index}\x1f{site}")
+        return rng
+
+    def calls(self, site: str) -> int:
+        return self._calls.get(site, 0)
+
+    @property
+    def n_fired(self) -> int:
+        return len(self.log)
+
+    def fire(self, site: str):
+        """Evaluate ``site``'s call against the plan. Returns ``None`` or a
+        ``("nan", row)`` payload-corruption directive; raises
+        ``TransientFault`` for a matched error spec; sleeps for delays."""
+        idx = self._calls.get(site, 0)
+        self._calls[site] = idx + 1
+        directive = None
+        error: FaultEvent | None = None
+        for i, spec in enumerate(self.specs):
+            if not spec.matches(site) or idx < spec.start_after:
+                continue
+            if (spec.max_fires is not None
+                    and self._fires.get(i, 0) >= spec.max_fires):
+                continue
+            # The draw happens for every eligible call so the stream stays
+            # aligned with the call sequence regardless of what fired.
+            if self._rng(i, site).random() >= spec.p:
+                continue
+            self._fires[i] = self._fires.get(i, 0) + 1
+            ev = FaultEvent(site=site, call_index=idx, kind=spec.kind,
+                            spec_index=i, row=spec.row)
+            self.log.append(ev)
+            if spec.kind == "delay":
+                time.sleep(spec.delay_s)
+            elif spec.kind == "nan" and directive is None:
+                directive = ("nan", spec.row if spec.row is not None else 0)
+            elif spec.kind == "error" and error is None:
+                error = ev
+        if error is not None:
+            raise TransientFault(
+                f"injected fault at {error.site}[{error.call_index}] "
+                f"(spec {error.spec_index}, seed {self.seed})")
+        return directive
+
+
+def default_chaos_plan(seed: int = 0, *, error_p: float = 0.08,
+                       nan_p: float = 0.05, delay_s: float = 0.0,
+                       nan_row: int = 0) -> FaultPlan:
+    """The stock chaos mix used by ``bench.py --chaos`` and
+    ``scripts/serve_smoke.py --chaos``: occasional transient step/allocator
+    errors (all retryable), one NaN-poisoned slot row per firing, and an
+    optional slow-rank delay on the step sites. ``start_after`` skips each
+    site's first call so warmup/compile always succeeds."""
+    specs = [
+        FaultSpec(site="engine.decode", kind="error", p=error_p,
+                  start_after=1),
+        FaultSpec(site="engine.prefill", kind="error", p=error_p,
+                  start_after=1),
+        FaultSpec(site="pool.ensure", kind="error", p=error_p / 2,
+                  start_after=2),
+        FaultSpec(site="engine.decode", kind="nan", p=nan_p, row=nan_row,
+                  start_after=1),
+    ]
+    if delay_s > 0.0:
+        specs.append(FaultSpec(site="engine.decode", kind="delay",
+                               p=error_p, delay_s=delay_s))
+    return FaultPlan(specs, seed=seed)
+
+
+# ---------------------------------------------------------------------------
+# Process-global installation (the ledger/tracer pattern: module attribute,
+# one attribute check per call site when off)
+# ---------------------------------------------------------------------------
+
+_PLAN: FaultPlan | None = None
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    global _PLAN
+    _PLAN = plan
+    return plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    _PLAN = None
+
+
+def active() -> bool:
+    return _PLAN is not None
+
+
+def get_plan() -> FaultPlan | None:
+    return _PLAN
+
+
+def fire(site: str):
+    """Module-level fire: no-op (None) when no plan is installed."""
+    plan = _PLAN
+    return plan.fire(site) if plan is not None else None
+
+
+@contextlib.contextmanager
+def plan(p: FaultPlan):
+    """Scoped install (restores the prior plan, usually None)."""
+    global _PLAN
+    prior = _PLAN
+    _PLAN = p
+    try:
+        yield p
+    finally:
+        _PLAN = prior

@@ -1,0 +1,86 @@
+"""Error boundaries for the serving path: NaN/Inf logit guards and
+bounded exponential-backoff retry for transient failures.
+
+The design constraint is SPMD safety: on a TPU mesh, failure HANDLING must
+never become divergent control flow inside a compiled program (one rank
+taking a different branch than its peers deadlocks the collectives). So:
+
+- the NaN/Inf check is compiled INTO the batched steps unconditionally —
+  every rank computes the same tiny ``finite_logits_mask`` reduction
+  (models/sampling.py) and returns it as a per-slot bool vector; the
+  GUARD ACTION (quarantining the poisoned request) is host-side slot
+  churn, which the compiled step already expresses as data (mask/tables).
+- retry re-runs the WHOLE step function on the host; the compiled program
+  itself is oblivious. Only ``TransientFault`` (and whatever the caller
+  adds to ``retryable``) is retried — real programming errors propagate
+  immediately.
+
+``RetryPolicy.run`` also reports recovery latency (first failure ->
+eventual success) through the optional ``on_recovery`` callback, which the
+batch engine wires to its ``recovery_s`` histogram — the "how long were we
+degraded" number the chaos bench arm publishes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from triton_distributed_tpu.resilience.faults import TransientFault
+
+
+class QuarantineError(RuntimeError):
+    """Attached to a request quarantined by a guard (``Request.error``
+    carries the message; the exception type exists for callers that want
+    to re-raise per-request failures)."""
+
+
+@dataclasses.dataclass
+class RetryPolicy:
+    """Bounded exponential backoff over retryable exceptions.
+
+    ``retries``       additional attempts after the first (0 = no retry)
+    ``base_delay_s``  sleep before the first retry; doubles each retry,
+                      capped at ``max_delay_s``
+    ``retryable``     exception types worth re-running (transients only —
+                      retrying a real bug just fails N times slower)
+    """
+
+    retries: int = 3
+    base_delay_s: float = 0.005
+    max_delay_s: float = 0.5
+    retryable: tuple = (TransientFault,)
+
+    def run(self, fn, *, on_retry=None, on_recovery=None,
+            sleep=time.sleep):
+        """Call ``fn()`` with up to ``retries`` re-attempts.
+
+        ``on_retry(attempt, exc)`` fires before each backoff sleep;
+        ``on_recovery(seconds)`` fires on an eventual success that needed
+        at least one retry, with the first-failure -> success latency."""
+        delay = self.base_delay_s
+        first_failure_t: float | None = None
+        for attempt in range(self.retries + 1):
+            try:
+                out = fn()
+            except self.retryable as e:
+                if first_failure_t is None:
+                    first_failure_t = time.monotonic()
+                if attempt == self.retries:
+                    raise
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                sleep(min(delay, self.max_delay_s))
+                delay *= 2.0
+                continue
+            if first_failure_t is not None and on_recovery is not None:
+                on_recovery(time.monotonic() - first_failure_t)
+            return out
+        raise AssertionError("unreachable")  # pragma: no cover
+
+
+def bad_rows(finite_mask, active_rows) -> list[int]:
+    """Rows among ``active_rows`` whose logits failed the finite check.
+    ``finite_mask`` is the per-slot bool vector the compiled steps return
+    (True = all logits finite)."""
+    return [i for i in active_rows if not bool(finite_mask[i])]

@@ -1,0 +1,211 @@
+"""Host-side watchdog: deadlines on blocking sections, a serving-step
+heartbeat, and diagnostic snapshots on breach.
+
+The TPU-specific hazard this guards: divergent host control flow deadlocks
+the SPMD mesh (one rank skips a collective the others entered — cf. the
+consensus notes in ``runtime/autotuner.py``), and a hung collective hangs
+the process SILENTLY. Nothing host-side can un-hang a device program, but
+the watchdog turns "silent hang" into "diagnosable incident":
+
+  deadline(name, s)   context manager around a blocking section (a host
+                      collective wrapper, a serving step). A timer thread
+                      fires at breach: it dumps a diagnostic snapshot
+                      (metrics + comm ledger + the engine's in-flight
+                      request table) to ``snapshot_path`` / stderr, then —
+                      if the section EVER returns — ``WatchdogTimeout`` is
+                      raised at scope exit (late completion is still a
+                      breach: the mesh may have diverged meanwhile). For a
+                      true hang, ``on_breach="interrupt"`` additionally
+                      posts ``KeyboardInterrupt`` to the main thread, the
+                      only portable way to break a blocked host wait.
+  heartbeat(...)      staleness monitor for the serving loop: the engine
+                      ``beat()``s every step; an optional daemon thread
+                      dumps a snapshot when beats stop arriving, and the
+                      next ``beat()``/``check()`` after a breach raises.
+
+Collective entry points get deadlines without touching kernels/: install
+the watchdog's hook into ``obs.comm_ledger`` (``resilience.install_hooks``)
+and every host-level ``timed()`` wrapper runs under
+``deadline(f"comm.{collective}", collective_deadline_s)``.
+
+Snapshots are plain dicts: ``{reason, wall_time, ...provider()...,
+comm_ledger}``. The provider is typically
+``BatchEngine.resilience_snapshot`` (metrics + in-flight table + pool
+stats). Everything here is off unless a ``Watchdog`` is constructed and
+attached — zero hooks fire by default.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import sys
+import threading
+import time
+import _thread
+
+
+class WatchdogTimeout(RuntimeError):
+    """A watched section breached its deadline (even if it later finished:
+    late completion past a deadline is treated as failure — the rest of the
+    mesh may have diverged while this rank was stuck)."""
+
+
+class Watchdog:
+    """Deadline + heartbeat monitor with snapshot-on-breach.
+
+    ``snapshot_provider``  zero-arg callable returning a JSON-able dict
+                           merged into every snapshot (the engine's
+                           metrics / in-flight request table).
+    ``snapshot_path``      file the breach snapshot is written to (JSON);
+                           None = stderr only.
+    ``on_breach``          "raise" (default): record + dump, raise at scope
+                           exit. "interrupt": additionally post
+                           KeyboardInterrupt to the main thread so a truly
+                           hung wait gets broken.
+    """
+
+    def __init__(self, *, snapshot_provider=None, snapshot_path: str | None
+                 = None, on_breach: str = "raise"):
+        if on_breach not in ("raise", "interrupt"):
+            raise ValueError(f"on_breach {on_breach!r}: expected 'raise' "
+                             f"or 'interrupt'")
+        self.snapshot_provider = snapshot_provider
+        self.snapshot_path = snapshot_path
+        self.on_breach = on_breach
+        self.breaches: list[str] = []
+        self.last_snapshot: dict | None = None
+        self._lock = threading.Lock()
+
+    # -- snapshots ----------------------------------------------------------
+
+    def snapshot(self, reason: str) -> dict:
+        """Collect + persist the diagnostic snapshot for ``reason``."""
+        snap: dict = {"reason": reason, "wall_time": time.time()}
+        if self.snapshot_provider is not None:
+            try:
+                snap.update(self.snapshot_provider())
+            except Exception as e:  # noqa: BLE001 — never mask the breach
+                snap["provider_error"] = f"{type(e).__name__}: {e}"
+        try:
+            from triton_distributed_tpu.obs import comm_ledger
+
+            snap["comm_ledger"] = comm_ledger.snapshot()
+        except Exception as e:  # noqa: BLE001
+            snap["comm_ledger_error"] = f"{type(e).__name__}: {e}"
+        with self._lock:
+            self.last_snapshot = snap
+        payload = json.dumps(snap, default=str)
+        if self.snapshot_path is not None:
+            try:
+                d = os.path.dirname(os.path.abspath(self.snapshot_path))
+                os.makedirs(d, exist_ok=True)
+                with open(self.snapshot_path, "w") as f:
+                    f.write(payload)
+            except OSError:
+                pass  # diagnostics must never crash the diagnosis
+        from triton_distributed_tpu.runtime.utils import dist_print
+
+        dist_print(f"[watchdog] BREACH {reason}: {payload[:2000]}",
+                   file=sys.stderr, flush=True)
+        return snap
+
+    def _breach(self, name: str) -> None:
+        self.breaches.append(name)
+        self.snapshot(name)
+        if self.on_breach == "interrupt":
+            _thread.interrupt_main()
+
+    # -- deadlines ----------------------------------------------------------
+
+    @contextlib.contextmanager
+    def deadline(self, name: str, seconds: float | None):
+        """Bound a blocking section. ``seconds=None`` disables (nullpath)."""
+        if seconds is None:
+            yield self
+            return
+        n_before = len(self.breaches)
+        tag = f"deadline:{name}:{seconds}s"
+        timer = threading.Timer(seconds, self._breach, args=(tag,))
+        timer.daemon = True
+        timer.start()
+        try:
+            yield self
+        finally:
+            timer.cancel()
+        if len(self.breaches) > n_before:
+            raise WatchdogTimeout(
+                f"{name} exceeded its {seconds}s deadline (snapshot "
+                f"dumped{': ' + self.snapshot_path if self.snapshot_path else ' to stderr'})")
+
+    def heartbeat(self, name: str = "serving_step", *,
+                  interval_s: float = 30.0, monitor: bool = False
+                  ) -> "Heartbeat":
+        return Heartbeat(self, name, interval_s=interval_s, monitor=monitor)
+
+
+class Heartbeat:
+    """Staleness detector for a loop that should tick at least every
+    ``interval_s``: call ``beat()`` per iteration. ``check()`` (or the
+    optional monitor thread) flags a breach when beats stop; the breach
+    surfaces as ``WatchdogTimeout`` on the NEXT beat()/check() — a hung
+    step that eventually returns fails loudly instead of resuming as if
+    nothing happened."""
+
+    def __init__(self, watchdog: Watchdog, name: str, *,
+                 interval_s: float = 30.0, monitor: bool = False):
+        self.watchdog = watchdog
+        self.name = name
+        self.interval_s = interval_s
+        self._last = time.monotonic()
+        self._breached = False
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if monitor:
+            self.start_monitor()
+
+    def start_monitor(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._monitor, daemon=True,
+                                        name=f"watchdog-{self.name}")
+        self._thread.start()
+
+    def stop_monitor(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=1.0)
+            self._thread = None
+
+    def _monitor(self) -> None:
+        while not self._stop.wait(self.interval_s / 4):
+            self._check_stale()
+
+    def _check_stale(self) -> bool:
+        if (not self._breached
+                and time.monotonic() - self._last > self.interval_s):
+            self._breached = True
+            self.watchdog._breach(
+                f"heartbeat:{self.name}:{self.interval_s}s")
+        return self._breached
+
+    def beat(self) -> None:
+        """Mark liveness; raises if a breach was flagged since the last
+        beat (the loop stalled past ``interval_s`` and must not silently
+        resume)."""
+        self._check_stale()
+        self._last = time.monotonic()
+        if self._breached:
+            self._breached = False
+            raise WatchdogTimeout(
+                f"{self.name} heartbeat gap exceeded {self.interval_s}s "
+                f"(snapshot dumped)")
+
+    def check(self) -> None:
+        """Raise if the loop has already gone stale (for external pollers
+        — e.g. a health probe asking 'is the serving loop alive?')."""
+        if self._check_stale():
+            self._breached = False
+            raise WatchdogTimeout(
+                f"{self.name} heartbeat stale (> {self.interval_s}s)")

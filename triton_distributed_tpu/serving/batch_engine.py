@@ -27,6 +27,18 @@ slot-batched run emits the SAME tokens as N independent single-sequence
 ``Engine`` runs — masked cache positions contribute exact zeros, every
 per-row op is row-independent, and chunked prefill attends causally so
 later-chunk keys never influence earlier logits.
+
+Resilience (resilience/, docs/resilience.md): the engine is an error
+boundary, not a crash amplifier. A failing request is QUARANTINED — moved
+to ``failed`` with ``Request.status='failed'`` and an error string — while
+the batch keeps running; transient step/allocator faults retry with
+bounded backoff; NaN/Inf logits are caught by a finite-mask the steps
+compile in unconditionally. All of it is SPMD-safe by construction:
+failure handling is host-side slot churn over the same (mask, tables,
+offsets) DATA the compiled step already consumes, so no rank ever takes a
+divergent in-program branch and the step shapes never change. With no
+``FaultPlan`` installed and no watchdog attached the hot path pays one
+attribute check per site and emits bit-identical tokens.
 """
 
 from __future__ import annotations
@@ -40,8 +52,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models.engine import Engine
-from triton_distributed_tpu.models.sampling import sample_token
+from triton_distributed_tpu.models.sampling import finite_logits_mask, sample_token
 from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.resilience import faults as _faults
+from triton_distributed_tpu.resilience import guards as _guards
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
@@ -73,12 +87,26 @@ class BatchEngine:
                    ``n_slots * ceil(max_seq_len/block_size)`` to oversubscribe.
     ``prefill_chunk`` tokens of prompt consumed per mixed step and the
                    mixed step's fixed ids width.
+    ``admission_pressure`` fraction of the pool that must be free to admit
+                   NEW requests while at least one slot is running (0.0 =
+                   off). Backpressure trades queue wait for fewer
+                   preemptions when the pool is oversubscribed; it never
+                   pauses admission into an idle engine (no deadlock).
+    ``retry``      ``RetryPolicy`` for transient step/allocator faults
+                   (default: 3 retries, exponential backoff).
+    ``nan_guard``  quarantine requests whose logits go non-finite even
+                   with no fault plan installed. The finite mask itself is
+                   ALWAYS compiled into the steps (SPMD safety — see
+                   module docstring); this flag only enables the host-side
+                   check of it.
     """
 
     def __init__(self, engine: Engine, *, n_slots: int = 8,
                  n_blocks: int | None = None, block_size: int = 16,
                  prefill_chunk: int = 32, max_seq_len: int | None = None,
-                 seed: int = 0):
+                 seed: int = 0, admission_pressure: float = 0.0,
+                 retry: _guards.RetryPolicy | None = None,
+                 nan_guard: bool = False):
         self.engine = engine
         world = engine.mesh.shape[engine.model.axis]
         if engine.decode_mode in ("dist", "xla") and n_slots % world:
@@ -99,7 +127,19 @@ class BatchEngine:
         self._admit_seq = 0
         self._req_counter = 0
         self._finished: dict[object, Request] = {}
+        self._failed: dict[object, Request] = {}
         self._key = jax.random.PRNGKey(seed)
+        # resilience state
+        self.admission_pressure = admission_pressure
+        self.retry = _guards.RetryPolicy() if retry is None else retry
+        self.nan_guard = nan_guard
+        self._watchdog = None
+        self._heartbeat = None
+        self._step_deadline_s = None
+        # The always-present logit-corruption operand: zeros on every
+        # normal step; a fault directive swaps in a row of NaN. One cached
+        # device array, so the disabled path never re-uploads.
+        self._corrupt0 = jnp.zeros((n_slots,), jnp.float32)
         self._build_steps()
 
     # -- compiled steps -----------------------------------------------------
@@ -112,29 +152,40 @@ class BatchEngine:
         temperature, top_p = eng.temperature, eng.top_p
         trace_counts = self.trace_counts
 
+        # ``corrupt`` (n_slots,) f32 is zeros on the healthy path: adding it
+        # to the logits is an exact no-op for sampling, and swapping NaN
+        # into one row on the host is how fault injection poisons a slot
+        # WITHOUT a second compiled variant. ``finite`` is the matching
+        # always-compiled guard (models/sampling.finite_logits_mask): every
+        # rank computes it every step, only the host decides what to do.
+
         @functools.partial(jax.jit, donate_argnums=(2, 3))
         def decode_step(params, tok, k, v, offsets, block_tables, slot_mask,
-                        key):
+                        corrupt, key):
             # Trace-time side effect: counts COMPILATIONS, not calls — the
             # one-compile-across-churn guarantee the tests assert on.
             trace_counts["decode"] += 1
             ids = jnp.clip(tok, 0, V - 1)[:, None]
             logits, k, v = sm_dec(params, ids, k, v, offsets, block_tables,
                                   slot_mask)
+            logits = logits + corrupt[:, None]
+            finite = finite_logits_mask(logits)
             nxt = sample_token(logits, key, temperature=temperature,
                                top_p=top_p)
-            return nxt, k, v
+            return nxt, finite, k, v
 
         @functools.partial(jax.jit, donate_argnums=(2, 3))
         def mixed_step(params, ids, k, v, offsets, block_tables, slot_mask,
-                       seq_lens, key):
+                       seq_lens, corrupt, key):
             trace_counts["prefill"] += 1
             ids = jnp.clip(ids, 0, V - 1)
             logits, k, v = sm_pre(params, ids, k, v, offsets, block_tables,
                                   slot_mask, seq_lens)
+            logits = logits + corrupt[:, None]
+            finite = finite_logits_mask(logits)
             nxt = sample_token(logits, key, temperature=temperature,
                                top_p=top_p)
-            return nxt, k, v
+            return nxt, finite, k, v
 
         self._decode_step = decode_step
         self._mixed_step = mixed_step
@@ -144,6 +195,111 @@ class BatchEngine:
             return None        # greedy: sample_token never touches the key
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    # -- resilience plumbing ------------------------------------------------
+
+    @property
+    def _guarding(self) -> bool:
+        return _faults._PLAN is not None or self.nan_guard
+
+    def attach_watchdog(self, wd, *, step_deadline_s: float | None = None,
+                        heartbeat_interval_s: float | None = None,
+                        monitor: bool = False):
+        """Wire a ``resilience.Watchdog`` into the serving loop: every
+        compiled-step dispatch runs under ``deadline('serving_step',
+        step_deadline_s)``, each completed step beats a heartbeat, and the
+        watchdog's breach snapshots pull ``resilience_snapshot()`` (metrics
+        + the in-flight request table). Returns ``wd``."""
+        wd.snapshot_provider = self.resilience_snapshot
+        self._watchdog = wd
+        self._step_deadline_s = step_deadline_s
+        if heartbeat_interval_s is not None:
+            self._heartbeat = wd.heartbeat(
+                "serving_step", interval_s=heartbeat_interval_s,
+                monitor=monitor)
+        return wd
+
+    def resilience_snapshot(self) -> dict:
+        """Diagnostic snapshot: metrics, pool/queue stats, and the
+        in-flight request table — what the watchdog dumps on breach."""
+        plan = _faults.get_plan()
+        return {
+            "in_flight": [
+                {"slot": i, "req_id": s.req.req_id,
+                 "phase": "prefill" if s.prefilling else "decode",
+                 "offset": s.offset, "ctx_len": len(s.ctx),
+                 "generated": len(s.req.output),
+                 "priority": s.req.priority,
+                 "n_preemptions": s.req.n_preemptions}
+                for i, s in enumerate(self._slots) if s is not None],
+            "queue_depth": len(self.scheduler),
+            "pool": {"n_blocks": self.pool.n_blocks,
+                     "n_free": self.pool.n_free,
+                     "n_used": self.pool.n_used},
+            "requests": {"completed": len(self._finished),
+                         "failed": len(self._failed)},
+            "faults_fired": plan.n_fired if plan is not None else 0,
+            "metrics": self.metrics.as_dict(),
+        }
+
+    def _call_step(self, site: str, fn):
+        """Dispatch one compiled step through the fault plane + retry.
+
+        ``fn(corrupt)`` runs the jitted step with the given corruption
+        operand. With no plan installed this is a direct call with the
+        cached zero operand (one attribute check). With a plan, each
+        attempt re-fires the ``site`` BEFORE touching the jitted function —
+        so a raised ``TransientFault`` never consumes the donated KV
+        buffers and the retry re-runs against intact state. (Real
+        device-side failures are out of retry's scope for exactly that
+        donation reason.)"""
+        if _faults._PLAN is None:
+            return fn(self._corrupt0)
+
+        def attempt():
+            corrupt = self._corrupt0
+            directive = _faults.fire(site)   # may raise / sleep
+            if directive is not None and directive[0] == "nan":
+                row = directive[1] % self.n_slots
+                arr = np.zeros((self.n_slots,), np.float32)
+                arr[row] = np.nan
+                corrupt = jnp.asarray(arr)
+                self.metrics.inc("faults_nan_injected")
+                _trace.instant("fault_nan", site=site, row=row)
+            return fn(corrupt)
+
+        def on_retry(attempt_i, exc):
+            self.metrics.inc("faults_injected")
+            self.metrics.inc("step_retries")
+            _trace.instant("fault_retry", site=site, attempt=attempt_i,
+                           error=str(exc))
+
+        def on_recovery(seconds):
+            self.metrics.inc("step_recoveries")
+            self.metrics.observe("recovery_s", seconds)
+
+        return self.retry.run(attempt, on_retry=on_retry,
+                              on_recovery=on_recovery)
+
+    def _ensure_blocks(self, seq_id, n_tokens: int) -> bool:
+        """``pool.ensure`` through the retry policy (the ``pool.ensure``
+        fault site fires inside ``KVPool.ensure`` itself). Raises
+        ``TransientFault`` only after the retry budget is spent."""
+        if _faults._PLAN is None:
+            return self.pool.ensure(seq_id, n_tokens)
+
+        def on_retry(attempt_i, exc):
+            self.metrics.inc("faults_injected")
+            self.metrics.inc("alloc_retries")
+            _trace.instant("fault_retry", site="pool.ensure",
+                           attempt=attempt_i, error=str(exc))
+
+        def on_recovery(seconds):
+            self.metrics.inc("alloc_recoveries")
+            self.metrics.observe("recovery_s", seconds)
+
+        return self.retry.run(lambda: self.pool.ensure(seq_id, n_tokens),
+                              on_retry=on_retry, on_recovery=on_recovery)
 
     # -- request lifecycle --------------------------------------------------
 
@@ -175,12 +331,42 @@ class BatchEngine:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return
+        if (self.admission_pressure > 0.0
+                and len(free) < self.n_slots       # engine not idle
+                and len(self.scheduler)
+                and self.pool.n_free / self.pool.n_blocks
+                    < self.admission_pressure):
+            # Backpressure: let the running residents drain before adding
+            # contenders that would immediately trigger eviction churn.
+            # Never applied to an idle engine, so progress is guaranteed.
+            self.metrics.inc("admission_backpressure")
+            _trace.instant("backpressure", waiting=len(self.scheduler),
+                           pool_free=self.pool.n_free)
+            return
+        if _faults._PLAN is not None:
+            try:
+                _faults.fire("sched.admit")
+            except _faults.TransientFault as e:
+                # Admission is naturally idempotent: nothing was popped
+                # yet, so "degrade" = skip this round and try next step.
+                self.metrics.inc("faults_injected")
+                self.metrics.inc("admissions_deferred")
+                _trace.instant("fault_admit", error=str(e))
+                return
         admitted = self.scheduler.admit(free_slots=len(free),
                                         free_blocks=self.pool.n_free,
                                         block_size=self.pool.block_size)
         for req in admitted:
             ctx = req.prompt + req.output
-            ok = self.pool.ensure(req.req_id, len(ctx) + 1)
+            try:
+                ok = self._ensure_blocks(req.req_id, len(ctx) + 1)
+            except _faults.TransientFault:
+                # Allocator faulted past the retry budget: requeue rather
+                # than fail the request — admission hasn't touched a slot.
+                self.scheduler.requeue(req)
+                self.metrics.inc("admissions_deferred")
+                _trace.instant("admit_deferred", req=req.req_id)
+                continue
             assert ok, "scheduler admitted beyond the pool budget"
             self._slots[free.pop(0)] = _Slot(req=req,
                                              admit_seq=self._admit_seq,
@@ -207,21 +393,40 @@ class BatchEngine:
 
     def _ensure_or_preempt(self, idx: int) -> bool:
         """Grow slot ``idx``'s table for its next token write, evicting
-        victims (possibly ``idx`` itself) until the allocation fits."""
+        victims (possibly ``idx`` itself) until the allocation fits.
+        Victim selection honors the scheduler's aging cap; when EVERY
+        candidate has aged out the cap is overridden (liveness beats
+        fairness — the pool is full and somebody must yield)."""
         s = self._slots[idx]
-        while not self.pool.ensure(s.req.req_id, s.offset + 1):
+        while True:
+            try:
+                if self._ensure_blocks(s.req.req_id, s.offset + 1):
+                    return True
+            except _faults.TransientFault:
+                # Allocator faulted past the retry budget mid-decode:
+                # degrade by preempting THIS slot (eviction-by-recompute
+                # loses no output) instead of crashing the batch.
+                self.metrics.inc("degraded_preemptions")
+                _trace.instant("degraded_preempt", req=s.req.req_id,
+                               slot=idx)
+                self._preempt(idx)
+                return False
+            running = [(j, t.req, t.admit_seq)
+                       for j, t in enumerate(self._slots) if t is not None]
             victim = Scheduler.select_victim(
-                (j, t.req, t.admit_seq)
-                for j, t in enumerate(self._slots) if t is not None)
-            assert victim is not None, "no evictable slot but pool is full"
+                running, preemption_cap=self.scheduler.preemption_cap)
+            if victim is None:
+                victim = Scheduler.select_victim(running)
+                assert victim is not None, "no evictable slot but pool full"
+                self.metrics.inc("aging_overridden")
             self._preempt(victim)
             if victim == idx:
                 return False
-        return True
 
     def _finish(self, idx: int):
         s = self._slots[idx]
         s.req.finish_t = time.monotonic()
+        s.req.status = "ok"
         self.pool.release(s.req.req_id)
         self._slots[idx] = None
         self._finished[s.req.req_id] = s.req
@@ -230,6 +435,26 @@ class BatchEngine:
         _trace.async_end("request", s.req.req_id,
                          tokens=len(s.req.output),
                          preemptions=s.req.n_preemptions)
+
+    def _quarantine(self, idx: int, reason: str):
+        """Fail ONE request without failing the batch: release its blocks,
+        empty its slot, park it in ``failed`` with an error status. Pure
+        host-side slot churn — the next step's (mask, tables, offsets)
+        simply exclude the row, same as a finish, so nothing about the
+        compiled program or the surviving rows changes."""
+        s = self._slots[idx]
+        req = s.req
+        req.status = "failed"
+        req.error = reason
+        req.finish_t = time.monotonic()
+        self.pool.release(req.req_id)
+        self._slots[idx] = None
+        self._failed[req.req_id] = req
+        self.metrics.inc("requests_failed")
+        _trace.instant("quarantine", req=req.req_id, slot=idx,
+                       reason=reason)
+        _trace.async_end("request", req.req_id, tokens=len(req.output),
+                         failed=True, error=reason)
 
     def _record_token(self, s: _Slot, tok: int):
         s.req.output.append(tok)
@@ -267,10 +492,17 @@ class BatchEngine:
                                self.pool.n_used / self.pool.n_blocks)
         if not active:
             return False
-        if any(self._slots[i].prefilling for i in active):
-            self._run_mixed()
+        run = (self._run_mixed
+               if any(self._slots[i].prefilling for i in active)
+               else self._run_decode)
+        if self._watchdog is not None:
+            with self._watchdog.deadline("serving_step",
+                                         self._step_deadline_s):
+                run()
+            if self._heartbeat is not None:
+                self._heartbeat.beat()
         else:
-            self._run_decode()
+            run()
         return True
 
     def _operands(self):
@@ -282,20 +514,33 @@ class BatchEngine:
         return (jnp.asarray(offsets), jnp.asarray(tables),
                 jnp.asarray(mask))
 
+    def _guard_rows(self, finite) -> None:
+        """Host half of the NaN/Inf guard: quarantine every active row
+        whose logits failed the compiled finite check. Costs a device
+        transfer, so it only runs while guarding (fault plan installed or
+        ``nan_guard=True``) — the mask itself is computed every step."""
+        active = [i for i, s in enumerate(self._slots) if s is not None]
+        for i in _guards.bad_rows(np.asarray(finite), active):
+            self._quarantine(i, "non-finite logits (NaN/Inf guard)")
+
     def _run_decode(self):
         tok = np.array([s.last_tok if s else 0 for s in self._slots],
                        np.int32)
         offsets, tables, mask = self._operands()
         st = self.pool.state
+        key = self._next_key()   # drawn ONCE — retries replay the same key
         with _trace.span("decode_step",
                          active=int(sum(s is not None for s in self._slots))):
-            nxt, k, v = self._decode_step(self.engine.params,
-                                          jnp.asarray(tok),
-                                          st.k, st.v, offsets, tables, mask,
-                                          self._next_key())
+            nxt, finite, k, v = self._call_step(
+                "engine.decode",
+                lambda corrupt: self._decode_step(
+                    self.engine.params, jnp.asarray(tok), st.k, st.v,
+                    offsets, tables, mask, corrupt, key))
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
         self.metrics.inc("decode_steps")
+        if self._guarding:
+            self._guard_rows(finite)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -320,17 +565,21 @@ class BatchEngine:
                 seq_lens[i] = 1
         offsets, tables, mask = self._operands()
         st = self.pool.state
+        key = self._next_key()   # drawn ONCE — retries replay the same key
         with _trace.span("mixed_step",
                          prefill_rows=int((seq_lens > 1).sum()),
                          active=int(sum(s is not None for s in self._slots))):
-            nxt, k, v = self._mixed_step(self.engine.params,
-                                         jnp.asarray(ids),
-                                         st.k, st.v, offsets, tables, mask,
-                                         jnp.asarray(seq_lens),
-                                         self._next_key())
+            nxt, finite, k, v = self._call_step(
+                "engine.prefill",
+                lambda corrupt: self._mixed_step(
+                    self.engine.params, jnp.asarray(ids), st.k, st.v,
+                    offsets, tables, mask, jnp.asarray(seq_lens), corrupt,
+                    key))
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
         self.metrics.inc("prefill_steps")
+        if self._guarding:
+            self._guard_rows(finite)
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
@@ -346,11 +595,26 @@ class BatchEngine:
 
     def run(self, max_steps: int | None = None) -> dict:
         """Step until idle (or ``max_steps``); returns
-        ``{req_id: [generated token ids]}`` for every finished request."""
+        ``{req_id: [generated token ids]}`` for every SUCCESSFUL request.
+        Quarantined requests are in ``failed`` (status + error string) —
+        a chaos run completes instead of crashing."""
         steps = 0
+        idle = 0
         while max_steps is None or steps < max_steps:
-            if not self.step():
+            if self.step():
+                idle = 0
+            elif not len(self.scheduler):
                 break
+            else:
+                # Nothing active but requests still queued: admission was
+                # deferred (injected sched.admit fault). Spin the scheduler
+                # again — bounded, so a pathological plan (p=1.0 error on
+                # admission forever) fails loudly instead of hanging.
+                idle += 1
+                if idle > 1000:
+                    raise RuntimeError(
+                        "admission made no progress for 1000 consecutive "
+                        "idle steps (fault plan blocking all admission?)")
             steps += 1
         return {rid: list(req.output)
                 for rid, req in self._finished.items()}
@@ -358,3 +622,9 @@ class BatchEngine:
     @property
     def finished(self) -> dict:
         return dict(self._finished)
+
+    @property
+    def failed(self) -> dict:
+        """Quarantined requests: ``{req_id: Request}`` with
+        ``status='failed'`` and ``error`` set."""
+        return dict(self._failed)

@@ -34,6 +34,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from triton_distributed_tpu.models.kv_cache import KVCache
+from triton_distributed_tpu.resilience import faults as _faults
 
 
 @jax.tree_util.register_dataclass
@@ -105,22 +106,44 @@ class KVPool:
         """Grow ``seq_id``'s table until it covers ``n_tokens`` tokens.
         Returns False (allocating NOTHING) if the free list can't cover the
         growth — all-or-nothing keeps admission/preemption decisions clean.
+
+        Fault site ``pool.ensure``: an installed ``FaultPlan`` may raise
+        ``TransientFault`` here (before any mutation, so the allocator
+        state is untouched — callers retry or degrade).
         """
+        if _faults._PLAN is not None:
+            _faults.fire("pool.ensure")
         if n_tokens > self.max_seq_len:
             raise ValueError(f"sequence length {n_tokens} exceeds pool "
                              f"max_seq_len {self.max_seq_len}")
-        table = self._tables.setdefault(seq_id, [])
-        need = self.blocks_for(n_tokens) - len(table)
+        table = self._tables.get(seq_id)
+        need = self.blocks_for(n_tokens) - (len(table) if table else 0)
         if need <= 0:
             return True
         if need > len(self._free):
+            # All-or-nothing, including the table entry itself: a rejected
+            # brand-new sequence must not leave an empty table behind (an
+            # empty table is indistinguishable from a released-then-
+            # resurrected ghost; check_invariants flags both).
             return False
+        if table is None:
+            table = self._tables[seq_id] = []
         table.extend(self._free.pop() for _ in range(need))
         return True
 
     def release(self, seq_id) -> None:
-        """Return all of ``seq_id``'s blocks to the free list."""
-        for b in reversed(self._tables.pop(seq_id, [])):
+        """Return all of ``seq_id``'s blocks to the free list.
+
+        Unknown (never-ensured or already-released) ``seq_id`` raises —
+        the silent no-op it used to be masked double-release bugs, and a
+        later ``ensure()`` of the same id would resurrect a stale table
+        over freshly-allocated blocks with unrelated KV contents."""
+        table = self._tables.pop(seq_id, None)
+        if table is None:
+            raise KeyError(
+                f"release of unknown seq_id {seq_id!r}: never allocated or "
+                f"already released (double release?)")
+        for b in reversed(table):
             self._free.append(b)
 
     def table(self, seq_id) -> list[int]:
@@ -139,10 +162,15 @@ class KVPool:
         return out
 
     def check_invariants(self) -> None:
-        """Allocator soundness: free + owned partition the pool exactly."""
+        """Allocator soundness: free + owned partition the pool exactly,
+        and no sequence holds an EMPTY table (an empty table is a stale
+        ghost — released or never funded — that a later ``ensure()`` would
+        silently resurrect)."""
         owned = [b for t in self._tables.values() for b in t]
         assert len(set(owned)) == len(owned), "block owned twice"
         assert len(set(self._free)) == len(self._free), "free list duplicate"
         assert not (set(owned) & set(self._free)), "block both free and owned"
         assert len(owned) + len(self._free) == self.n_blocks, "blocks leaked"
         assert all(0 <= b < self.n_blocks for b in owned + self._free)
+        empty = [sid for sid, t in self._tables.items() if not t]
+        assert not empty, f"empty (stale) tables for seq_ids {empty!r}"

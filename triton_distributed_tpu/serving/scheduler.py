@@ -23,6 +23,14 @@ Policies (deliberately simple, swappable):
              original arrival_seq, so under greedy sampling its remaining
              output is unchanged (the re-prefill of prompt+generated yields
              the same next token the evicted decode would have).
+  aging      a request preempted ``preemption_cap`` times becomes
+             NON-EVICTABLE: without the cap, a low-priority request under
+             sustained higher-priority pressure livelocks (evict ->
+             requeue -> re-prefill -> evict, forever, burning recompute
+             each lap). ``select_victim(..., preemption_cap=n)`` skips
+             aged requests; the batch engine falls back to ignoring the
+             cap only when EVERY candidate is aged (liveness beats
+             fairness — somebody must yield or no slot can grow).
 """
 
 from __future__ import annotations
@@ -50,6 +58,10 @@ class Request:
     first_token_t: float | None = None
     finish_t: float | None = None
     n_preemptions: int = 0
+    # resilience: "pending" -> "ok" | "failed"; ``error`` holds the reason
+    # when the batch engine quarantines the request instead of crashing.
+    status: str = "pending"
+    error: str | None = None
 
     @property
     def remaining_new(self) -> int:
@@ -65,9 +77,12 @@ class Request:
 class Scheduler:
     """Priority-FIFO waiting queue + admission control + victim selection."""
 
-    def __init__(self):
+    def __init__(self, *, preemption_cap: int | None = 4):
         self._heap: list[tuple[int, int, Request]] = []
         self._seq = itertools.count()
+        # After this many evictions a request ages out of the victim pool
+        # (see module docstring). None disables aging.
+        self.preemption_cap = preemption_cap
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -108,13 +123,18 @@ class Scheduler:
         return admitted
 
     @staticmethod
-    def select_victim(running, *, exclude=()):
+    def select_victim(running, *, exclude=(), preemption_cap=None):
         """Pick the eviction victim among ``running`` (iterable of
         (key, Request, admit_seq)): lowest priority, then latest admitted.
-        Returns the winning key, or None if nothing is evictable."""
+        With ``preemption_cap``, requests already preempted that many times
+        are aged out of the candidate pool (anti-starvation). Returns the
+        winning key, or None if nothing is evictable."""
         best = None
         for key, req, admit_seq in running:
             if key in exclude:
+                continue
+            if (preemption_cap is not None
+                    and req.n_preemptions >= preemption_cap):
                 continue
             rank = (req.priority, -admit_seq)
             if best is None or rank < best[0]:

@@ -19,10 +19,18 @@ Performs, in order, printing one `[pod_check] ...` line per stage:
 Exit code 0 = the pod is ready for the full framework. Any hang here is a
 rendezvous/topology problem, not a framework one — check
 JAX_COORDINATOR_ADDRESS / MEGASCALE_* per the runbook.
+
+``--deadline SECONDS`` turns the check into a bounded HEALTH PROBE: every
+stage runs under a ``resilience.Watchdog`` deadline, so a wedged
+rendezvous or a hung collective — the classic silent multi-host failure
+mode — becomes a loud exit 2 with a diagnostic snapshot on stderr within
+SECONDS, instead of a job that sits in the queue forever. That makes the
+tool safe to wire into an orchestrator liveness check.
 """
 
 from __future__ import annotations
 
+import contextlib
 import sys
 
 import jax
@@ -37,7 +45,33 @@ def log(msg: str) -> None:
     dist_print(f"[pod_check] {msg}", flush=True)
 
 
-def main() -> int:
+def main(deadline_s: float | None = None) -> int:
+    """Run the staged check; with ``deadline_s``, every stage is bounded
+    by a watchdog deadline (exit 2 + snapshot on breach)."""
+    wd = None
+    if deadline_s is not None:
+        from triton_distributed_tpu.resilience import Watchdog
+
+        # "interrupt" posts KeyboardInterrupt into the blocked main thread
+        # on breach: a hung rendezvous/collective can't be cancelled
+        # host-side, but the PROBE must still come back with a verdict.
+        wd = Watchdog(on_breach="interrupt")
+
+    def stage(name: str):
+        return (wd.deadline(name, deadline_s) if wd is not None
+                else contextlib.nullcontext())
+
+    try:
+        return _run_stages(stage)
+    except BaseException as e:  # noqa: BLE001 — includes the interrupt
+        if wd is None or not wd.breaches:
+            raise
+        log(f"FAIL: deadline breached in {wd.breaches[-1]} "
+            f"({type(e).__name__})")
+        return 2
+
+
+def _run_stages(stage) -> int:
     from triton_distributed_tpu.runtime.mesh import (
         Topology,
         initialize_distributed,
@@ -45,19 +79,21 @@ def main() -> int:
         make_mesh,
     )
 
-    initialize_distributed()
+    with stage("rendezvous"):
+        initialize_distributed()
     log(f"rendezvous ok: process {jax.process_index()}/{jax.process_count()}")
 
     topo = Topology.detect()
     log(f"topology: {topo.num_devices} x {topo.device_kind} on "
         f"{topo.num_processes} host(s), {topo.num_slices} slice(s)")
 
-    if topo.multi_slice:
-        mesh = make_2d_mesh(topo)
-        axes = ("dcn", "ici")
-    else:
-        mesh = make_mesh({"tp": topo.num_devices})
-        axes = ("tp",)
+    with stage("mesh"):
+        if topo.multi_slice:
+            mesh = make_2d_mesh(topo)
+            axes = ("dcn", "ici")
+        else:
+            mesh = make_mesh({"tp": topo.num_devices})
+            axes = ("tp",)
     log(f"mesh: {dict(mesh.shape)}")
 
     # XLA collective sanity: psum of each device's global rank over every
@@ -70,15 +106,16 @@ def main() -> int:
             out = jax.lax.psum(out, ax)
         return out
 
-    total = jax.jit(shard_map(psum_all, mesh=mesh,
-                                  in_specs=P(axes if len(axes) > 1 else axes[0]),
-                                  out_specs=P(axes if len(axes) > 1 else axes[0]),
-                                  check_vma=False))(x)
-    expect = float(x.sum())
-    # Read only this host's shard: a global fetch of a multi-host array
-    # raises "spans non-addressable devices" — exactly the deployment this
-    # tool exists for. Every shard holds the same psum value.
-    got = float(total.addressable_shards[0].data.ravel()[0])
+    with stage("xla_psum"):
+        total = jax.jit(shard_map(psum_all, mesh=mesh,
+                                      in_specs=P(axes if len(axes) > 1 else axes[0]),
+                                      out_specs=P(axes if len(axes) > 1 else axes[0]),
+                                      check_vma=False))(x)
+        expect = float(x.sum())
+        # Read only this host's shard: a global fetch of a multi-host array
+        # raises "spans non-addressable devices" — exactly the deployment
+        # this tool exists for. Every shard holds the same psum value.
+        got = float(total.addressable_shards[0].data.ravel()[0])
     if abs(got - expect) > 1e-3:
         log(f"FAIL: psum got {got}, want {expect}")
         return 1
@@ -93,12 +130,13 @@ def main() -> int:
     world = topo.num_devices
     rows = jnp.arange(world * 8 * 128, dtype=jnp.float32
                       ).reshape(world, 8, 128)
-    gathered = all_gather(rows, mesh=mesh, axis=ici,
-                          dcn_axis=axes[0] if topo.multi_slice else None)
-    # The gathered result is replicated: every host's addressable shard
-    # holds the full (world*8, 128) array — compare locally, never fetch
-    # across hosts.
-    local = jnp.asarray(gathered.addressable_shards[0].data)
+    with stage("pallas_allgather"):
+        gathered = all_gather(rows, mesh=mesh, axis=ici,
+                              dcn_axis=axes[0] if topo.multi_slice else None)
+        # The gathered result is replicated: every host's addressable shard
+        # holds the full (world*8, 128) array — compare locally, never
+        # fetch across hosts.
+        local = jnp.asarray(gathered.addressable_shards[0].data)
     ok = (local.shape == (world * 8, 128) and bool(
         jnp.allclose(local, jnp.arange(world * 8 * 128, dtype=jnp.float32
                                        ).reshape(world * 8, 128))))
@@ -111,4 +149,7 @@ def main() -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    deadline = None
+    if "--deadline" in sys.argv:
+        deadline = float(sys.argv[sys.argv.index("--deadline") + 1])
+    sys.exit(main(deadline))
