@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# Perf flight recorder end-to-end smoke: record real runs, then gate them.
+#
+# Wires the three pieces of the recorder together the way CI would:
+#
+#   1. scripts/serve_smoke.py --perfdb   -> serving TTFT/TBT/throughput run
+#   2. python bench.py --perfdb          -> bench run (cpu-fallback on a
+#                                           no-TPU host, by design: this
+#                                           smoke must pass anywhere)
+#   3. tools/perf_gate.py --db ...       -> compare newest vs history,
+#                                           markdown report, gate verdict
+#
+# Each suite records TWICE so the second run has a baseline to gate
+# against. The gate runs with a LOOSE tolerance (default 0.5 = 50%):
+# back-to-back runs on a shared box differ by wall-clock noise, and this
+# smoke verifies the WIRING — ingest, fingerprinting, comparison, report —
+# not micro-level perf stability. CI perf gating proper uses the default
+# 8% tolerance against an accumulated history:
+#
+#   python tools/perf_gate.py --db perfdb.jsonl --suite bench \
+#       --ingest bench_out.json --tolerance 0.08
+#
+# Usage: bash scripts/perf_gate_smoke.sh [workdir]
+# Exits nonzero if any stage fails or the gate reports a (>50%!) regression.
+set -euo pipefail
+
+cd "$(dirname "$0")/.."
+
+WORKDIR="${1:-$(mktemp -d /tmp/perf_gate_smoke.XXXXXX)}"
+mkdir -p "$WORKDIR"
+DB="$WORKDIR/perfdb.jsonl"
+TOL="${PERF_GATE_SMOKE_TOLERANCE:-0.5}"
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+# serve_smoke.py imports the package relative to the repo root.
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
+
+echo "perf_gate_smoke: workdir=$WORKDIR db=$DB tolerance=$TOL" >&2
+
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_smoke run $i/2" >&2
+  python scripts/serve_smoke.py --duration 2 --rate 8 --perfdb "$DB" \
+    > "$WORKDIR/serve_out.$i.json"
+done
+
+for i in 1 2; do
+  echo "perf_gate_smoke: bench run $i/2" >&2
+  python bench.py --perfdb "$DB" > "$WORKDIR/bench_out.$i.json"
+  # The one-JSON-line stdout contract: the last line must parse.
+  python - "$WORKDIR/bench_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+EOF
+done
+
+echo "perf_gate_smoke: gating serve_smoke suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_smoke \
+  --tolerance "$TOL" --report "$WORKDIR/serve_report.md"
+
+echo "perf_gate_smoke: gating bench suite" >&2
+python tools/perf_gate.py --db "$DB" --suite bench \
+  --tolerance "$TOL" --report "$WORKDIR/bench_report.md"
+
+echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

@@ -30,11 +30,14 @@ import numpy as np
 
 
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
-         n_blocks: int | None = 12, seed: int = 0, chaos: bool = False
-         ) -> dict:
+         n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
+         perfdb_path: str | None = None) -> dict:
     """Run the load, return the metrics dict. Raises RuntimeError on any
     retrace beyond the first compile of each step kind; with ``chaos``,
-    also on any violation of the graceful-degradation contract."""
+    also on any violation of the graceful-degradation contract.
+    ``perfdb_path`` appends the run's TTFT/TBT/throughput sample to the
+    perf flight recorder's run database (obs/perfdb.py) so
+    ``tools/perf_gate.py`` can gate serving latency across PRs."""
     import contextlib
 
     import jax
@@ -142,6 +145,19 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
             raise RuntimeError(
                 f"{kind} step retraced {n} times — slot churn must be "
                 "data, not shape")
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = be.perfdb_sample()
+        if m["wall_s"]:
+            sample["serve_tokens_per_s"] = round(
+                float(m["tokens_generated"]) / float(m["wall_s"]), 2)
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_chaos" if chaos else "serve_smoke",
+            metrics=sample,
+            meta={"duration_s": duration_s, "rate_hz": rate_hz,
+                  "seed": seed})
+        m["perfdb_run_id"] = rec.run_id
     return m
 
 
@@ -154,10 +170,14 @@ if __name__ == "__main__":
     ap.add_argument("--chaos", action="store_true",
                     help="install the fault plan; assert graceful "
                          "degradation (>=1 quarantined, >=1 completed)")
+    ap.add_argument("--perfdb", default=None,
+                    help="append this run's TTFT/TBT/throughput sample to "
+                         "the PerfDB JSONL at this path (tools/perf_gate.py "
+                         "gates on it)")
     args = ap.parse_args()
     try:
         metrics = main(args.duration, rate_hz=args.rate, seed=args.seed,
-                       chaos=args.chaos)
+                       chaos=args.chaos, perfdb_path=args.perfdb)
     except RuntimeError as e:
         print(f"FAIL: {e}")
         raise SystemExit(1)

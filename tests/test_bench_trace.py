@@ -34,6 +34,9 @@ def test_trace_arm_emits_all_artifacts(tmp_path, monkeypatch):
         return result
 
     monkeypatch.setattr(bench, "_run_benchmarks", fake_run)
+    # Pin the full-bench path: without this, a no-TPU host routes main()
+    # to the cpu-fallback arm instead of the (stubbed) benchmark body.
+    monkeypatch.setenv("TDT_BENCH_FORCE_FULL", "1")
     monkeypatch.setattr(sys, "argv",
                         ["bench.py", "--trace", "--trace-dir", str(tmp_path)])
 

@@ -312,6 +312,17 @@ def test_ledger_selfcheck_consistent(mesh8):
     assert not comm_ledger.enabled()
 
 
+def test_ledger_selfcheck_covers_all_reduce_and_all_to_all(mesh8):
+    """The selfcheck invariant extends to the reducing and permuting
+    families: recorded bytes must equal the analytical wire bytes for AR
+    (at whatever method the wrapper's own dispatch picks) and EP a2a."""
+    sc = comm_ledger.selfcheck(mesh=mesh8, axis="tp")
+    for fam in ("ar", "a2a"):
+        assert sc[f"{fam}_bytes"] == sc[f"{fam}_expected"] > 0
+        assert sc[f"{fam}_mode"] in ("executed", "analytical")
+    assert sc["consistent"]
+
+
 def test_instrumented_all_gather_records_when_enabled(mesh8):
     """End-to-end through the real kernel wrapper: enabling the ledger and
     calling ``all_gather`` must produce a ledger entry whose bytes match
@@ -361,3 +372,125 @@ def test_ledger_thread_safety(led):
         t.join()
     (e,) = led.get("all_gather")
     assert e.calls == 800 and e.bytes_total == 800.0
+
+
+# ---------------------------------------------------------------------------
+# roofline attribution
+# ---------------------------------------------------------------------------
+
+
+from triton_distributed_tpu.obs import roofline  # noqa: E402
+
+
+V5E = pm.match_hardware("tpu v5 lite")
+# Synthetic chip with an absurdly fat interconnect: forces the HBM branch
+# for wired (world > 1) collectives, which no real TPU row exercises.
+FAT_ICI = pm.Hardware("fat-ici", 1e15, 1e9, 1e12, 6, 1e-6, 25e9, 10e-6)
+
+
+def test_collective_bound_world1_rides_hbm():
+    # Loopback / degenerate axis: no wire, the DMA rides HBM.
+    bound, bound_s = roofline.collective_bound(
+        "all_gather", nbytes=1e6, world=1, hw=V5E)
+    assert bound == "hbm"
+    assert bound_s == pytest.approx(2.0 * 1e6 / V5E.hbm_bw)
+
+
+def test_collective_bound_wired_world_is_ici_on_real_hw():
+    # On every real TPU row the aggregate ICI egress is the slower pipe.
+    bound, bound_s = roofline.collective_bound(
+        "all_gather", nbytes=1e6, world=8, hw=V5E)
+    assert bound == "ici"
+    assert bound_s == pytest.approx(
+        1e6 / (V5E.ici_link_bw * V5E.ici_links))
+    # Reducing collectives carry the 3x HBM touch but stay ICI-bound here.
+    bound_rs, _ = roofline.collective_bound(
+        "reduce_scatter", nbytes=1e6, world=8, hw=V5E)
+    assert bound_rs == "ici"
+
+
+def test_collective_bound_hbm_branch_when_ici_is_free():
+    bound, bound_s = roofline.collective_bound(
+        "reduce_scatter", nbytes=1e6, world=8, hw=FAT_ICI)
+    assert bound == "hbm"
+    assert bound_s == pytest.approx(3.0 * 1e6 / FAT_ICI.hbm_bw)
+
+
+def test_classify_step_compute_vs_hbm():
+    big_flops = roofline.classify_step(flops=1e12, hbm_bytes=1e3,
+                                       wall_s=1e-2, hw=V5E)
+    assert big_flops.bound == "compute"
+    assert big_flops.achieved_over_bound == pytest.approx(
+        1e-2 / (1e12 / V5E.peak_bf16_flops))
+    big_bytes = roofline.classify_step(flops=1e3, hbm_bytes=1e9,
+                                       wall_s=None, hw=V5E)
+    assert big_bytes.bound == "hbm"
+    assert big_bytes.achieved_over_bound is None     # never timed
+
+
+def test_attribute_joins_ledger_snapshot(led):
+    led.record("all_gather", axis="tp", world=8, nbytes=1e6,
+               method="ring_1d", wall_s=1e-3)
+    led.record("ep_all_to_all", axis="ep", world=8, nbytes=2e6,
+               method="stacked")                      # bytes only, no wall
+    recs = roofline.attribute(led.snapshot(roofline=False), hw=V5E)
+    ag = recs["all_gather[ring_1d,axis=tp,world=8]"]
+    assert ag.bound == "ici" and ag.calls == 1
+    assert ag.bytes_per_call == 1e6
+    assert ag.achieved_s == pytest.approx(1e-3)
+    # achieved >= bound: the efficiency fraction is >= 1 by construction.
+    assert ag.achieved_over_bound == pytest.approx(1e-3 / ag.bound_s)
+    assert ag.achieved_over_bound > 1.0
+    a2a = recs["ep_all_to_all[stacked,axis=ep,world=8]"]
+    assert a2a.achieved_s is None and a2a.achieved_over_bound is None
+    assert a2a.bound in ("ici", "hbm")
+
+    summ = roofline.summary(recs)
+    assert summ["sites"] == 2 and summ["timed_sites"] == 1
+    assert summ["worst_site"] == ag.site
+    assert summ["worst_achieved_over_bound"] == pytest.approx(
+        ag.achieved_over_bound, rel=1e-3)
+    assert roofline.summary({}) == {}
+
+
+def test_snapshot_joins_roofline_when_timed(led):
+    led.record("all_gather", axis="tp", world=8, nbytes=1e6,
+               method="ring_1d", wall_s=1e-3)
+    snap = led.snapshot()
+    e = snap["all_gather[ring_1d,axis=tp,world=8]"]
+    assert e["roofline_bound"] in ("ici", "hbm")
+    assert e["achieved_over_bound"] > 0
+    assert snap["roofline_summary"]["sites"] == 1
+    # JSON-ready end to end.
+    json.dumps(snap)
+
+
+def test_snapshot_skips_roofline_when_nothing_timed(led):
+    led.record("all_gather", axis="tp", world=8, nbytes=1e6)
+    snap = led.snapshot()
+    assert "roofline_summary" not in snap
+    assert "roofline_bound" not in snap["all_gather[auto,axis=tp,world=8]"]
+
+
+# ---------------------------------------------------------------------------
+# perf_model speeds-and-feeds single source of truth (bench.py delegates)
+# ---------------------------------------------------------------------------
+
+
+def test_peak_bf16_tflops_single_source():
+    assert pm.peak_bf16_tflops("TPU v5 lite") == pytest.approx(197.0)
+    # Marketing / short spellings resolve through the alias table.
+    assert pm.peak_bf16_tflops("v5e") == pytest.approx(197.0)
+    assert pm.peak_bf16_tflops("TPU v6e") == pytest.approx(918.0)
+    # bench.py's plausibility slack scales the peak...
+    assert pm.peak_bf16_tflops("TPU v4", tolerance=1.02) == pytest.approx(
+        275.0 * 1.02)
+    # ...and its unknown-device fallback returns the default UNSCALED.
+    assert pm.peak_bf16_tflops("quantum abacus", tolerance=1.02,
+                               default=1000.0) == 1000.0
+    assert pm.peak_bf16_tflops("quantum abacus") == pytest.approx(197.0)
+
+
+def test_hbm_gbps_from_table():
+    assert pm.hbm_gbps(V5E) == pytest.approx(819.0)
+    assert pm.hbm_gbps() > 0          # detect_hardware fallback path

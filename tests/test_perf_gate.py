@@ -1,0 +1,334 @@
+"""Tests for the perf flight recorder's gate half: the PerfDB JSONL run
+database (round-trip, corrupt-line resilience, fingerprint comparability),
+the robust-quartile comparison statistics, and the tools/perf_gate.py CLI
+end-to-end — a synthetic regression must trip the gate (exit 1) with a
+markdown report naming the regressed metric and its roofline class, an
+improvement or identical head must pass (exit 0), and an environment
+fingerprint mismatch must REFUSE the comparison (exit 2) rather than
+produce a category-error verdict.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+from triton_distributed_tpu.obs import perfdb as pdb
+from triton_distributed_tpu.obs import roofline
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+spec = importlib.util.spec_from_file_location(
+    "perf_gate", os.path.join(_REPO, "tools", "perf_gate.py"))
+perf_gate = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(perf_gate)
+
+
+FP = {"device_kind": "cpu", "world": 1, "backend": "cpu",
+      "jax_version": "0.4.37", "git_sha": "aaaa111", "interpret": True}
+FP_OTHER = {**FP, "device_kind": "TPU v5e", "backend": "tpu",
+            "interpret": False}
+
+
+def _seed_db(path, metrics_list, *, fp=FP, suite="bench"):
+    db = pdb.PerfDB(str(path))
+    for i, m in enumerate(metrics_list):
+        db.append(suite=suite, metrics=m, fingerprint_=dict(fp),
+                  ts=1000.0 + i)
+    return db
+
+
+# ---------------------------------------------------------------------------
+# PerfDB storage
+# ---------------------------------------------------------------------------
+
+
+def test_perfdb_round_trip(tmp_path):
+    db = pdb.PerfDB(str(tmp_path / "perf.jsonl"))
+    rec = db.append(suite="bench", metrics={"gemm_ms": 1.5, "note": "text",
+                                            "flag": True, "bad": float("nan")},
+                    fingerprint_=dict(FP), meta={"k": "v"}, ts=123.0)
+    # Non-numerics, bools, and NaN are dropped at write time.
+    assert rec.metrics == {"gemm_ms": 1.5}
+    (got,) = pdb.PerfDB(db.path).runs()
+    assert got.run_id == rec.run_id and got.ts == 123.0
+    assert got.suite == "bench" and got.metrics == {"gemm_ms": 1.5}
+    assert got.fingerprint == FP and got.meta == {"k": "v"}
+
+
+def test_perfdb_append_only_and_corrupt_line_skip(tmp_path):
+    path = tmp_path / "perf.jsonl"
+    db = _seed_db(path, [{"m_ms": 1.0}, {"m_ms": 2.0}])
+    with open(path, "a", encoding="utf-8") as f:
+        f.write("{torn json line\n")       # simulated torn write
+    db.append(suite="bench", metrics={"m_ms": 3.0}, fingerprint_=dict(FP),
+              ts=1010.0)
+    runs = db.runs()
+    assert [r.metrics["m_ms"] for r in runs] == [1.0, 2.0, 3.0]
+    assert db.skipped_lines == 1           # counted, not fatal
+
+
+def test_perfdb_filters_by_suite_and_fingerprint(tmp_path):
+    path = tmp_path / "perf.jsonl"
+    db = _seed_db(path, [{"a_ms": 1.0}])
+    db.append(suite="serve_smoke", metrics={"ttft_p50_ms": 9.0},
+              fingerprint_=dict(FP), ts=1005.0)
+    db.append(suite="bench", metrics={"a_ms": 5.0},
+              fingerprint_=dict(FP_OTHER), ts=1006.0)
+    assert len(db.runs()) == 3
+    assert len(db.runs(suite="bench")) == 2
+    # Fingerprint filter keeps only environment-comparable runs; git sha
+    # differences do NOT break comparability.
+    sha_differs = {**FP, "git_sha": "bbbb222"}
+    assert [r.metrics for r in db.runs(suite="bench",
+                                       fingerprint_=sha_differs)] \
+        == [{"a_ms": 1.0}]
+    assert db.samples("a_ms", suite="bench") == [1.0, 5.0]
+
+
+def test_fingerprint_never_raises_and_git_sha_env(monkeypatch):
+    monkeypatch.setenv("TDT_GIT_SHA", "cafe123")
+    fp = pdb.fingerprint()
+    assert fp["git_sha"] == "cafe123"
+    assert set(pdb.COMPARABLE_KEYS) <= set(fp)
+    assert "git_sha" not in pdb.COMPARABLE_KEYS   # shas are the payload
+
+
+# ---------------------------------------------------------------------------
+# Robust statistics + direction inference
+# ---------------------------------------------------------------------------
+
+
+def test_quartile_anchoring_one_sided_noise():
+    # Contention only inflates latency: the anchor must sit near the clean
+    # floor, not get dragged up by the outliers.
+    xs = [1.0, 1.01, 1.02, 1.05, 3.0, 8.0, 20.0, 50.0]
+    assert pdb.lower_quartile(xs) == 1.01
+    assert pdb.robust_anchor(xs, -1) == 1.01
+    # ...and deflates throughput: higher-better anchors the upper quartile.
+    ys = [100.0, 99.0, 98.0, 40.0, 10.0]
+    assert pdb.upper_quartile(ys) == 99.0     # nearest-rank ceil(3(n-1)/4)
+    assert pdb.robust_anchor(ys, 1) == 99.0
+    assert pdb.lower_quartile([5.0]) == pdb.upper_quartile([5.0]) == 5.0
+    assert pdb.robust_anchor([1.0, 2.0, 9.0], 0) == 2.0   # unknown: median
+
+
+@pytest.mark.parametrize("name,direction", [
+    ("gemm_ms", -1),
+    ("ttft_p95_ms", -1),
+    ("serve_tokens_per_s", 1),          # throughput despite the _s suffix
+    ("cpu_matmul_gflops", 1),
+    ("overlap_efficiency_frac", 1),
+    ("requests_failed", -1),
+    ("roofline_sites", 0),              # no _s substring false positive
+    ("trace_count_decode", 0),
+])
+def test_metric_direction(name, direction):
+    assert pdb.metric_direction(name) == direction
+
+
+def test_compare_signed_delta_and_tolerance():
+    base = [pdb.RunRecord("b", 1.0, "bench", dict(FP), {"x_ms": 1.0,
+                                                        "tok_per_s": 100.0})]
+    head = [pdb.RunRecord("h", 2.0, "bench", dict(FP), {"x_ms": 1.2,
+                                                        "tok_per_s": 80.0})]
+    by = {v.metric: v for v in pdb.compare(base, head, tolerance=0.08)}
+    # + always means worse: latency went up 20%, throughput fell 20%.
+    assert by["x_ms"].status == "regressed"
+    assert by["x_ms"].delta_frac == pytest.approx(0.2)
+    assert by["tok_per_s"].status == "regressed"
+    assert by["tok_per_s"].delta_frac == pytest.approx(0.2)
+    # Inside tolerance: unchanged. Improvement: negative delta.
+    by = {v.metric: v for v in pdb.compare(base, head, tolerance=0.25)}
+    assert by["x_ms"].status == "unchanged"
+    better = [pdb.RunRecord("h2", 3.0, "bench", dict(FP),
+                            {"x_ms": 0.5, "tok_per_s": 150.0})]
+    by = {v.metric: v for v in pdb.compare(base, better, tolerance=0.08)}
+    assert by["x_ms"].status == by["tok_per_s"].status == "improved"
+    assert by["x_ms"].delta_frac < 0 and by["tok_per_s"].delta_frac < 0
+
+
+def test_compare_new_gone_and_unknown_never_regress():
+    base = [pdb.RunRecord("b", 1.0, "bench", dict(FP),
+                          {"old_ms": 1.0, "mystery_count": 5.0})]
+    head = [pdb.RunRecord("h", 2.0, "bench", dict(FP),
+                          {"new_ms": 2.0, "mystery_count": 50.0})]
+    by = {v.metric: v for v in pdb.compare(base, head)}
+    assert by["old_ms"].status == "gone"
+    assert by["new_ms"].status == "new"
+    # 10x swing on a direction-unknown metric reports but never gates.
+    assert by["mystery_count"].status == "unchanged"
+
+
+def test_compare_refuses_fingerprint_mismatch():
+    base = [pdb.RunRecord("b", 1.0, "bench", dict(FP), {"x_ms": 1.0})]
+    head = [pdb.RunRecord("h", 2.0, "bench", dict(FP_OTHER), {"x_ms": 1.0})]
+    with pytest.raises(pdb.FingerprintMismatch, match="device_kind"):
+        pdb.compare(base, head)
+    # Escape hatch for cross-environment eyeballing.
+    verdicts = pdb.compare(base, head, check_fingerprints=False)
+    assert verdicts[0].metric == "x_ms"
+
+
+def test_compare_verdicts_carry_roofline_class():
+    base = [pdb.RunRecord("b", 1.0, "bench", dict(FP),
+                          {"gemm_ms": 1.0, "a2a_ms": 2.0,
+                           "ttft_p50_ms": 3.0})]
+    head = [pdb.RunRecord("h", 2.0, "bench", dict(FP),
+                          {"gemm_ms": 1.0, "a2a_ms": 2.0,
+                           "ttft_p50_ms": 3.0})]
+    by = {v.metric: v for v in pdb.compare(base, head)}
+    assert by["gemm_ms"].roofline == "compute"
+    assert by["a2a_ms"].roofline == "ici"
+    assert by["ttft_p50_ms"].roofline == "serving"
+
+
+# ---------------------------------------------------------------------------
+# perf_gate CLI
+# ---------------------------------------------------------------------------
+
+
+def test_gate_no_baseline_passes(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    _seed_db(path, [{"gemm_ms": 1.0}])
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench"])
+    assert rc == 0
+    assert "no comparable baseline" in capsys.readouterr().out
+
+
+def test_gate_identical_head_passes(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    _seed_db(path, [{"gemm_ms": 1.0, "serve_tokens_per_s": 50.0}] * 3)
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "no regression beyond 8.0% tolerance" in out
+
+
+def test_gate_synthetic_regression_trips(tmp_path, capsys):
+    """The acceptance fixture: degraded head exits nonzero and the markdown
+    names the regressed metric AND its roofline classification."""
+    path = tmp_path / "perf.jsonl"
+    base = {"gemm_ms": 1.0, "serve_tokens_per_s": 50.0}
+    _seed_db(path, [base, base, base,
+                    {"gemm_ms": 1.5, "serve_tokens_per_s": 49.0}])
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench",
+                         "--report", str(tmp_path / "report.md")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "**REGRESSED**" in out and "`gemm_ms`" in out
+    assert "compute-bound" in out          # roofline class in the verdict
+    assert "1 metric(s) regressed" in out
+    assert (tmp_path / "report.md").read_text() == out.rstrip("\n") + "\n"
+
+
+def test_gate_improvement_passes(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    base = {"gemm_ms": 1.0, "serve_tokens_per_s": 50.0}
+    _seed_db(path, [base, base, {"gemm_ms": 0.7,
+                                 "serve_tokens_per_s": 70.0}])
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "2 improved" in out
+
+
+def test_gate_refuses_cross_environment_head(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    db = _seed_db(path, [{"gemm_ms": 1.0}, {"gemm_ms": 1.0}])
+    db.append(suite="bench", metrics={"gemm_ms": 9.0},
+              fingerprint_=dict(FP_OTHER), ts=1009.0)
+    # Default: incomparable baselines are filtered out, so the TPU head has
+    # no baseline and passes-without-gating rather than cross-comparing.
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench"])
+    assert rc == 0
+    assert "no comparable baseline" in capsys.readouterr().out
+    # Forced cross-comparison is labeled, not refused.
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench",
+                         "--allow-fingerprint-mismatch"])
+    assert rc == 1          # 9x gemm_ms regression across environments
+
+
+def test_gate_metric_allowlist(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    base = {"gemm_ms": 1.0, "a2a_ms": 1.0}
+    _seed_db(path, [base, base, {"gemm_ms": 5.0, "a2a_ms": 1.0}])
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench",
+                         "--metrics", "a2a_ms"])
+    capsys.readouterr()
+    assert rc == 0          # regressed metric excluded from the gate
+
+
+def test_ingest_bench_one_line_json(tmp_path, capsys):
+    """bench.py's one-JSON-line contract: last parseable line wins, extras
+    flatten in, and two ingests of the same numbers gate green."""
+    out_file = tmp_path / "bench_out.json"
+    payload = {"metric": "gemm_rs_ms", "value": 3.25,
+               "backend": "cpu-fallback",
+               "extras": {"cpu_matmul_gflops": 12.0, "note": "text"}}
+    out_file.write_text("some warning noise\n"
+                        + json.dumps(payload) + "\n")
+    suite, flat = perf_gate.parse_result_file(str(out_file))
+    assert suite == "bench"
+    assert flat["gemm_rs_ms"] == 3.25
+    assert flat["cpu_matmul_gflops"] == 12.0
+    assert flat["backend_is_fallback"] == 1.0
+
+    db_path = tmp_path / "perf.jsonl"
+    for _ in range(2):
+        rc = perf_gate.main(["--db", str(db_path), "--suite", "bench",
+                             "--ingest", str(out_file)])
+        assert rc == 0
+    out = capsys.readouterr().out
+    assert "no regression" in out
+    runs = pdb.PerfDB(str(db_path)).runs(suite="bench")
+    assert len(runs) == 2
+    assert runs[0].metrics["gemm_rs_ms"] == 3.25
+
+
+def test_ingest_serve_smoke_shape(tmp_path):
+    obj = {"requests_submitted": 12, "trace_count_decode": 1,
+           "ttft_s_p50": 0.01}
+    suite, flat = perf_gate.flatten_result(obj)
+    assert suite == "serve_smoke"
+    assert flat["requests_submitted"] == 12
+
+
+def test_ingest_unparseable_file_is_usage_error(tmp_path, capsys):
+    bad = tmp_path / "garbage.txt"
+    bad.write_text("not json at all\n")
+    rc = perf_gate.main(["--db", str(tmp_path / "db.jsonl"),
+                         "--ingest", str(bad)])
+    capsys.readouterr()
+    assert rc == 2
+
+
+def test_gate_no_gate_records_only(tmp_path, capsys):
+    out_file = tmp_path / "bench_out.json"
+    out_file.write_text(json.dumps({"metric": "x_ms", "value": 1.0}) + "\n")
+    db_path = tmp_path / "perf.jsonl"
+    rc = perf_gate.main(["--db", str(db_path), "--ingest", str(out_file),
+                         "--no-gate"])
+    capsys.readouterr()
+    assert rc == 0
+    assert len(pdb.PerfDB(str(db_path)).runs()) == 1
+
+
+def test_report_names_worst_regression_with_class(tmp_path, capsys):
+    path = tmp_path / "perf.jsonl"
+    base = {"gemm_ms": 1.0, "a2a_ms": 1.0}
+    _seed_db(path, [base, base, {"gemm_ms": 1.2, "a2a_ms": 2.0}])
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    # Worst offender (a2a, +100%) leads the summary, labeled ici-bound.
+    assert "worst: `a2a_ms` (+100.0%, ici-bound)" in out
+
+
+def test_roofline_metric_class_families():
+    assert roofline.metric_class("gemm_rs_ms") == "compute"
+    assert roofline.metric_class("ep_a2a_dispatch_ms") == "ici"
+    assert roofline.metric_class("flash_decode_hbm_frac") == "hbm"
+    assert roofline.metric_class("serve_ttft_p95_ms") == "serving"
+    assert roofline.metric_class("completely_novel_thing") == "unknown"

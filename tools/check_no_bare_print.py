@@ -1,10 +1,14 @@
 #!/usr/bin/env python
-"""Lint: no bare ``print(`` inside the ``triton_distributed_tpu`` package.
+"""Lint: no bare ``print(`` inside ``triton_distributed_tpu`` or ``tools/``.
 
 On a multi-process TPU pod a bare print interleaves unprefixed lines from
 every host into one stream — undebuggable. Library code must route through
 ``runtime/utils.py:dist_print`` (rank-prefixed, rank-filterable); that file
-is the single allowed home of the underlying ``print`` call.
+is the single allowed home of the underlying ``print`` call. ``tools/``
+CLIs are in scope too (they run on pods via scripts/launch.sh): structured
+output goes through ``dist_print`` or raw ``sys.stdout.write`` JSON/
+markdown — the three legacy sweep/profile scripts are grandfathered in the
+allow list and take no new members.
 
 AST-based (not grep): ``print`` inside strings, comments, or docstrings is
 fine; only a real ``Name('print')`` call node is flagged. ``print``
@@ -21,26 +25,34 @@ import ast
 import os
 import sys
 
-# Files (package-relative, posix-style) allowed to call print directly.
+# Files (scan-root-relative, posix-style) allowed to call print directly.
 ALLOWED = {
     "runtime/utils.py",       # dist_print's own implementation
 }
 
+# Legacy tools/ scripts grandfathered before tools/ entered the lint scope
+# (single-host bench harnesses predating the pod story). New tools must be
+# clean — do not add entries.
+TOOLS_ALLOWED = {
+    "bench_ag_split.py",
+    "profile_decode.py",
+    "sweep_matmul.py",
+}
+
 PKG = "triton_distributed_tpu"
+TOOLS_DIR = "tools"
 
 
-def find_bare_prints(root: str) -> list[tuple[str, int]]:
-    """Scan ``{root}/triton_distributed_tpu`` and return (path, lineno) of
-    every bare print call outside the allow list."""
-    pkg_dir = os.path.join(root, PKG)
+def _scan_tree(scan_dir: str, allowed: set[str]
+               ) -> list[tuple[str, int]]:
     violations: list[tuple[str, int]] = []
-    for dirpath, _dirnames, filenames in os.walk(pkg_dir):
+    for dirpath, _dirnames, filenames in os.walk(scan_dir):
         for fname in sorted(filenames):
             if not fname.endswith(".py"):
                 continue
             path = os.path.join(dirpath, fname)
-            rel = os.path.relpath(path, pkg_dir).replace(os.sep, "/")
-            if rel in ALLOWED:
+            rel = os.path.relpath(path, scan_dir).replace(os.sep, "/")
+            if rel in allowed:
                 continue
             with open(path, encoding="utf-8") as f:
                 try:
@@ -52,6 +64,17 @@ def find_bare_prints(root: str) -> list[tuple[str, int]]:
                 if (isinstance(node, ast.Name) and node.id == "print"
                         and isinstance(node.ctx, ast.Load)):
                     violations.append((path, node.lineno))
+    return violations
+
+
+def find_bare_prints(root: str) -> list[tuple[str, int]]:
+    """Scan ``{root}/triton_distributed_tpu`` and ``{root}/tools`` and
+    return (path, lineno) of every bare print call outside the allow
+    lists."""
+    violations = _scan_tree(os.path.join(root, PKG), ALLOWED)
+    tools_dir = os.path.join(root, TOOLS_DIR)
+    if os.path.isdir(tools_dir):
+        violations += _scan_tree(tools_dir, TOOLS_ALLOWED)
     return violations
 
 

@@ -1,0 +1,223 @@
+#!/usr/bin/env python
+"""Perf gate: fail CI when a tracked performance number regresses.
+
+Ingests one or more bench / serve-smoke JSON outputs into the PerfDB
+(``obs/perfdb.py`` append-only JSONL), compares the newest run(s) against
+the prior history with the SAME environment fingerprint, prints a markdown
+regression report (stdout, optionally ``--report`` file), and exits
+
+    0   no regression beyond tolerance (or no baseline yet — a first run
+        cannot gate itself)
+    1   at least one tracked metric regressed beyond ``--tolerance``
+    2   refused: base and head fingerprints are not comparable (different
+        device kind / world / backend / interpret / jax version), or
+        usage error
+
+Every verdict is labeled with its roofline class (``obs.roofline``:
+compute / hbm / ici / serving) so a red gate names not just the metric but
+the resource to go look at.
+
+CI invocation (the exact line ``scripts/perf_gate_smoke.sh`` runs):
+
+    python tools/perf_gate.py --db perfdb.jsonl --suite bench \
+        --ingest bench_out.json --tolerance 0.08
+
+Ingest formats (auto-detected per file, last parseable JSON line wins —
+matching bench.py's one-JSON-line stdout contract):
+  - bench.py:       {"metric": ..., "value": ..., "extras": {...}}
+  - serve_smoke.py: flat metrics dict
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/perf_gate.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.obs import perfdb as pdb  # noqa: E402
+
+
+def _out(line: str = "") -> None:
+    sys.stdout.write(line + "\n")
+
+
+def _err(line: str) -> None:
+    sys.stderr.write(line + "\n")
+
+
+def parse_result_file(path: str) -> tuple[str, dict]:
+    """(inferred suite, flat numeric metrics) from a bench / serve-smoke
+    output file. Scans lines bottom-up for the last parseable JSON object
+    (the one-JSON-line contract tolerates warning noise above it)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    obj = None
+    for line in reversed(text.strip().splitlines()):
+        try:
+            cand = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(cand, dict):
+            obj = cand
+            break
+    if obj is None:
+        try:
+            obj = json.loads(text)
+        except ValueError:
+            raise ValueError(f"{path}: no parseable JSON object found")
+    return flatten_result(obj)
+
+
+def flatten_result(obj: dict) -> tuple[str, dict]:
+    """Flatten a result dict to (suite, {metric: value})."""
+    if "metric" in obj and "value" in obj:           # bench.py shape
+        flat = {str(obj["metric"]): obj["value"]}
+        flat.update(obj.get("extras", {}))
+        if "backend" in obj:
+            flat["backend_is_fallback"] = float(
+                obj["backend"] == "cpu-fallback")
+        return "bench", flat
+    if "backend" in obj and obj.get("backend") == "cpu-fallback":
+        flat = dict(obj.get("extras", obj))
+        return "bench", flat
+    suite = ("serve_smoke" if ("trace_count_decode" in obj
+                               or "requests_submitted" in obj)
+             else "result")
+    return suite, obj
+
+
+def render_report(verdicts, *, head, n_base: int, tolerance: float) -> str:
+    """Markdown regression report for one compare() result."""
+    regressed = [v for v in verdicts if v.status == "regressed"]
+    improved = [v for v in verdicts if v.status == "improved"]
+    fp = head.fingerprint
+    lines = [
+        "# Perf gate report",
+        "",
+        f"head: run `{head.run_id}` (suite `{head.suite}`, sha "
+        f"`{fp.get('git_sha', '?')}`) vs **{n_base}** baseline run(s)",
+        f"fingerprint: `{fp.get('device_kind')}` x{fp.get('world')} "
+        f"backend=`{fp.get('backend')}` interpret={fp.get('interpret')} "
+        f"jax={fp.get('jax_version')}",
+        f"tolerance: ±{tolerance * 100:.1f}% on the robust-quartile anchor",
+        "",
+        "| metric | class | better | base | head | Δ (+ = worse) |"
+        " verdict |",
+        "|---|---|---|---:|---:|---:|---|",
+    ]
+    arrow = {-1: "lower", 1: "higher", 0: "?"}
+
+    def fmt(v):
+        return "—" if v is None else f"{v:.6g}"
+
+    for v in sorted(verdicts,
+                    key=lambda v: -(v.delta_frac or 0.0)
+                    if v.status == "regressed" else 1.0):
+        delta = ("—" if v.delta_frac is None
+                 else f"{v.delta_frac * 100:+.1f}%")
+        mark = {"regressed": "**REGRESSED**", "improved": "improved",
+                "unchanged": "ok", "new": "new", "gone": "gone"}[v.status]
+        lines.append(
+            f"| `{v.metric}` | {v.roofline} | {arrow[v.direction]} |"
+            f" {fmt(v.base)} | {fmt(v.head)} | {delta} | {mark} |")
+    lines.append("")
+    if regressed:
+        worst = max(regressed, key=lambda v: v.delta_frac or 0.0)
+        lines.append(
+            f"**{len(regressed)} metric(s) regressed** beyond "
+            f"{tolerance * 100:.1f}% — worst: `{worst.metric}` "
+            f"({(worst.delta_frac or 0) * 100:+.1f}%, "
+            f"{worst.roofline}-bound).")
+    else:
+        lines.append(
+            f"no regression beyond {tolerance * 100:.1f}% tolerance"
+            + (f" ({len(improved)} improved)" if improved else "") + ".")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--db", required=True, help="PerfDB JSONL path")
+    ap.add_argument("--ingest", nargs="*", default=[],
+                    help="bench/serve-smoke JSON output files to record "
+                         "before gating")
+    ap.add_argument("--ingest-suite", default=None,
+                    help="override the inferred suite for --ingest files")
+    ap.add_argument("--suite", default=None,
+                    help="gate only this suite's runs")
+    ap.add_argument("--tolerance", type=float, default=0.08,
+                    help="relative regression tolerance (default 0.08)")
+    ap.add_argument("--head", type=int, default=1,
+                    help="newest N runs form the head sample (default 1)")
+    ap.add_argument("--metrics", default=None,
+                    help="comma-separated metric allowlist to gate on")
+    ap.add_argument("--report", default=None,
+                    help="also write the markdown report to this path")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="ingest/record only; skip the comparison")
+    ap.add_argument("--allow-fingerprint-mismatch", action="store_true",
+                    help="compare across environments anyway (labels only)")
+    args = ap.parse_args(argv)
+
+    db = pdb.PerfDB(args.db)
+
+    for path in args.ingest:
+        try:
+            suite, flat = parse_result_file(path)
+        except (OSError, ValueError) as e:
+            _err(f"perf_gate: cannot ingest {path}: {e}")
+            return 2
+        rec = db.append(suite=args.ingest_suite or suite, metrics=flat,
+                        meta={"source": os.path.abspath(path)})
+        _err(f"perf_gate: recorded run {rec.run_id} "
+             f"(suite {rec.suite}, {len(rec.metrics)} metrics)")
+
+    if args.no_gate:
+        return 0
+
+    runs = db.runs(suite=args.suite)
+    if db.skipped_lines:
+        _err(f"perf_gate: skipped {db.skipped_lines} corrupt db line(s)")
+    if not runs:
+        _err("perf_gate: empty database — nothing to gate")
+        return 0
+    head_runs = runs[-max(args.head, 1):]
+    head = head_runs[-1]
+    if args.allow_fingerprint_mismatch:
+        base_runs = runs[:-len(head_runs)]
+    else:
+        base_runs = [r for r in runs[:-len(head_runs)]
+                     if pdb.comparable(r.fingerprint, head.fingerprint)]
+    if not base_runs:
+        _out(f"perf gate: no comparable baseline for run `{head.run_id}` "
+             f"yet — recorded, not gated.")
+        return 0
+
+    metrics = (args.metrics.split(",") if args.metrics else None)
+    try:
+        verdicts = pdb.compare(
+            base_runs, head_runs, tolerance=args.tolerance, metrics=metrics,
+            check_fingerprints=not args.allow_fingerprint_mismatch)
+    except pdb.FingerprintMismatch as e:
+        _err(f"perf_gate: REFUSED — {e}")
+        return 2
+
+    report = render_report(verdicts, head=head, n_base=len(base_runs),
+                           tolerance=args.tolerance)
+    _out(report)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(report)
+    return 1 if any(v.status == "regressed" for v in verdicts) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

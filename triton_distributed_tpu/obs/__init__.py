@@ -17,17 +17,37 @@ One substrate, three views, threaded through every layer of the stack:
                    latency, fed by every collective entry point in
                    ``kernels/``. Near-zero-overhead no-op when disabled.
 
+Perf flight recorder (on top of the three views above):
+
+  obs.roofline     joins the comm ledger with ``runtime/perf_model``
+                   bounds: classifies every collective / step as compute-,
+                   HBM- or ICI-bound and emits per-site
+                   ``achieved_over_bound`` efficiency fractions.
+  obs.perfdb       append-only JSONL run database keyed by an environment
+                   fingerprint, with robust (best-quartile) delta
+                   statistics and ``compare()`` verdicts —
+                   ``tools/perf_gate.py`` gates CI on it.
+
 Everything here is disabled by default and costs one attribute check per
 call site when off — the serving/bench hot paths carry the hooks
 permanently. Design note: docs/observability.md.
 """
 
 from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
+from triton_distributed_tpu.obs import perfdb  # noqa: F401
+from triton_distributed_tpu.obs import roofline  # noqa: F401
 from triton_distributed_tpu.obs import trace  # noqa: F401
 from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
     CommLedger,
     LedgerEntry,
 )
+from triton_distributed_tpu.obs.perfdb import (  # noqa: F401
+    FingerprintMismatch,
+    PerfDB,
+    RunRecord,
+    Verdict,
+)
+from triton_distributed_tpu.obs.roofline import RooflineRecord  # noqa: F401
 from triton_distributed_tpu.obs.metrics import (  # noqa: F401
     Histogram,
     Metrics,
@@ -41,7 +61,9 @@ from triton_distributed_tpu.obs.trace import (  # noqa: F401
 )
 
 __all__ = [
-    "CommLedger", "LedgerEntry", "Histogram", "Metrics", "SpanRecord",
-    "Tracer", "comm_ledger", "group_profile", "merge_chrome_traces",
-    "parse_prometheus", "trace",
+    "CommLedger", "FingerprintMismatch", "Histogram", "LedgerEntry",
+    "Metrics", "PerfDB", "RooflineRecord", "RunRecord", "SpanRecord",
+    "Tracer", "Verdict", "comm_ledger", "group_profile",
+    "merge_chrome_traces", "parse_prometheus", "perfdb", "roofline",
+    "trace",
 ]

@@ -38,6 +38,7 @@ from resilience/ and the disabled path stays one attribute check.
 from __future__ import annotations
 
 import contextlib
+import copy
 import dataclasses
 import threading
 import time
@@ -109,10 +110,28 @@ class CommLedger:
     def bytes_for(self, collective: str) -> float:
         return sum(e.bytes_total for e in self.get(collective))
 
-    def snapshot(self) -> dict[str, dict]:
-        """``{series_key: aggregate dict}`` — JSON-ready."""
+    def snapshot(self, *, roofline: bool = True) -> dict[str, dict]:
+        """``{series_key: aggregate dict}`` — JSON-ready. When any series
+        carries achieved latency (wall samples), each entry is joined with
+        its physical roofline bound (``obs/roofline.py``): per-entry
+        ``roofline_bound`` / ``achieved_over_bound`` fields plus one
+        ``roofline_summary`` aggregate key (series keys always contain
+        ``[``, so the summary key can never collide)."""
         with self._lock:
-            return {e.key: e.as_dict() for e in self._entries.values()}
+            out = {e.key: e.as_dict() for e in self._entries.values()}
+        if roofline and any(d.get("wall_samples") for d in out.values()):
+            from triton_distributed_tpu.obs import roofline as _roofline
+
+            recs = _roofline.attribute(out)
+            for key, rec in recs.items():
+                out[key]["roofline_bound"] = rec.bound
+                if rec.achieved_over_bound is not None:
+                    out[key]["achieved_over_bound"] = round(
+                        rec.achieved_over_bound, 4)
+            summ = _roofline.summary(recs)
+            if summ:
+                out["roofline_summary"] = summ
+        return out
 
     # -- recording ----------------------------------------------------------
 
@@ -255,11 +274,12 @@ def ledger(reset_first: bool = False):
 
 
 def selfcheck(mesh=None, axis: str = "tp") -> dict:
-    """Byte-accounting cross-check: run one all-gather and one
-    reduce-scatter through the instrumented host wrappers and compare the
-    ledger's byte counters against the perf model's analytical wire-byte
-    counts — the acceptance invariant for the ledger (recorded == analytic
-    for at least AG and RS).
+    """Byte-accounting cross-check: run one all-gather, one
+    reduce-scatter, one all-reduce and one EP all-to-all through the
+    instrumented host wrappers and compare the ledger's byte counters
+    against the perf model's analytical wire-byte counts — the acceptance
+    invariant for the ledger (recorded == analytic for every collective
+    family).
 
     Where the backend cannot lower the Pallas collectives (a CPU host
     without the TPU interpreter), the call is replayed analytically through
@@ -272,6 +292,14 @@ def selfcheck(mesh=None, axis: str = "tp") -> dict:
     import jax.numpy as jnp
 
     from triton_distributed_tpu.kernels.allgather import all_gather
+    from triton_distributed_tpu.kernels.allreduce import (
+        all_reduce,
+        choose_all_reduce_method,
+    )
+    from triton_distributed_tpu.kernels.ep_all_to_all import (
+        AllToAllContext,
+        all_to_all,
+    )
     from triton_distributed_tpu.kernels.reduce_scatter import reduce_scatter
     from triton_distributed_tpu.runtime import perf_model as pm
     from triton_distributed_tpu.runtime.mesh import make_mesh
@@ -286,39 +314,76 @@ def selfcheck(mesh=None, axis: str = "tp") -> dict:
     ag_expected = pm.wire_bytes_all_gather(x_ag.nbytes // world, world)
     x_rs = jnp.ones((world, world * 4, 128), jnp.float32)
     rs_expected = pm.wire_bytes_reduce_scatter(x_rs.nbytes // world, world)
+    # AR over a (world, world*8, 128) stacked input: method mirrors the
+    # wrapper's own dispatch so expected bytes == recorded bytes by
+    # construction of the SAME (method, nbytes) pair.
+    x_ar = jnp.ones((world, max(world, 2) * 8, 128), jnp.float32)
+    ar_method = choose_all_reduce_method(
+        world, x_ar.nbytes // world, x_ar.shape[1])
+    ar_expected = pm.wire_bytes_all_reduce(
+        x_ar.nbytes // world, world, ar_method.value)
+    # EP a2a at a tiny aligned geometry: (world, world, cap, 128) f32.
+    a2a_ctx = AllToAllContext(capacity=8, hidden=128, axis=axis,
+                              chunk_rows=8)
+    x_a2a = jnp.ones((world, world, 8, 128), jnp.float32)
+    a2a_counts = jnp.full((world, world), 8, jnp.int32)
+    a2a_expected = pm.wire_bytes_all_to_all(x_a2a.nbytes // world, world)
 
     prior_entries = dict(_LEDGER._entries)
+    checks: dict[str, dict] = {}
+
+    def host_bytes(led: CommLedger, collective: str) -> float:
+        """Host-level (timed / replayed) bytes only. A host wrapper may
+        ALSO fire a device-level trace-time record for the same traffic
+        (a2a's dispatch entry point inside the stacked wrapper): counting
+        both would double the bytes. Traced series stand in only when no
+        host record exists for the collective at all."""
+        entries = led.get(collective)
+        host = [e for e in entries if e.calls > 0]
+        return sum(e.bytes_total for e in (host or entries))
+
+    def run_one(name: str, collective: str, fn, expected: float,
+                method: str) -> None:
+        before = copy.deepcopy(_LEDGER._entries)
+        try:
+            jax.block_until_ready(fn())
+            mode = "executed"
+        except Exception:  # noqa: BLE001 — no Pallas lowering here
+            # Drop whatever the failed attempt recorded at trace time —
+            # the analytical replay below is the whole record.
+            _LEDGER._entries = before
+            record(collective, axis=axis, world=world, nbytes=expected,
+                   method=method or "analytical")
+            mode = "analytical"
+        checks[name] = {"collective": collective,
+                        "expected": float(expected), "mode": mode}
+
     try:
         with ledger(reset_first=True) as led:
-            try:
-                jax.block_until_ready(all_gather(x_ag, mesh=mesh, axis=axis))
-                ag_mode = "executed"
-            except Exception:  # noqa: BLE001 — no Pallas lowering here
-                record("all_gather", axis=axis, world=world,
-                       nbytes=ag_expected, method="analytical")
-                ag_mode = "analytical"
-            try:
-                jax.block_until_ready(
-                    reduce_scatter(x_rs, mesh=mesh, axis=axis))
-                rs_mode = "executed"
-            except Exception:  # noqa: BLE001
-                record("reduce_scatter", axis=axis, world=world,
-                       nbytes=rs_expected, method="analytical")
-                rs_mode = "analytical"
-            ag_bytes = led.bytes_for("all_gather")
-            rs_bytes = led.bytes_for("reduce_scatter")
+            run_one("ag", "all_gather",
+                    lambda: all_gather(x_ag, mesh=mesh, axis=axis),
+                    ag_expected, "")
+            run_one("rs", "reduce_scatter",
+                    lambda: reduce_scatter(x_rs, mesh=mesh, axis=axis),
+                    rs_expected, "")
+            run_one("ar", "all_reduce",
+                    lambda: all_reduce(x_ar, mesh=mesh, axis=axis,
+                                       method=ar_method),
+                    ar_expected, ar_method.value)
+            run_one("a2a", "ep_all_to_all",
+                    lambda: all_to_all(x_a2a, a2a_counts, ctx=a2a_ctx,
+                                       mesh=mesh),
+                    a2a_expected, "stacked")
+            for c in checks.values():
+                c["bytes"] = host_bytes(led, c["collective"])
             entries = led.snapshot()
     finally:
         _LEDGER._entries = prior_entries
-    return {
-        "world": world,
-        "ag_bytes": ag_bytes,
-        "ag_expected": float(ag_expected),
-        "ag_mode": ag_mode,
-        "rs_bytes": rs_bytes,
-        "rs_expected": float(rs_expected),
-        "rs_mode": rs_mode,
-        "consistent": (ag_bytes == float(ag_expected)
-                       and rs_bytes == float(rs_expected)),
-        "entries": entries,
-    }
+    out: dict = {"world": world, "entries": entries}
+    for name, c in checks.items():
+        out[f"{name}_bytes"] = c["bytes"]
+        out[f"{name}_expected"] = c["expected"]
+        out[f"{name}_mode"] = c["mode"]
+    out["consistent"] = all(c["bytes"] == c["expected"]
+                            for c in checks.values())
+    return out

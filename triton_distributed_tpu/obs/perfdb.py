@@ -1,0 +1,350 @@
+"""PerfDB: append-only JSONL run database behind the perf flight recorder.
+
+Every bench / serve-smoke invocation can append one run record — a flat
+``{metric: value}`` dict keyed by an ENVIRONMENT FINGERPRINT (device kind,
+world size, backend, jax version, git sha, interpret-mode flag). The gate
+(tools/perf_gate.py) then compares the newest run against the history with
+the SAME comparable fingerprint and fails CI on regression. This is the
+project's analog of the reference autotuner's persisted per-config timing
+records: numbers survive the process so winners (and losers) are decided
+across runs, not vibes.
+
+Storage is one JSON object per line, append-only — concurrent writers
+interleave whole lines (O_APPEND), history is never rewritten, and a
+corrupt line (torn write, hand edit) skips with a count rather than
+poisoning the database.
+
+Robust statistics: the same one-sided-noise rationale as bench.py's slope
+filter. Co-tenant contention only ever makes latency samples WORSE —
+inflates ms, deflates tokens/s — so the honest per-side anchor is the
+best-observed quartile: lower quartile for lower-is-better metrics, upper
+quartile for higher-is-better. Both sides anchor identically, so the
+delta compares least-contended against least-contended.
+
+Fingerprint comparability: two runs are comparable when every key in
+``COMPARABLE_KEYS`` matches — git sha and timestamp are deliberately
+EXCLUDED (comparing shas is the gate's whole purpose). A mismatch on
+device kind / world / backend / interpret / jax version REFUSES the
+comparison (``FingerprintMismatch``): a v5e number against a cpu-fallback
+number is not a regression, it is a category error.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import subprocess
+import time
+import uuid
+
+SCHEMA_VERSION = 1
+
+# Fingerprint keys that must match for two runs to be comparable.
+COMPARABLE_KEYS = ("device_kind", "world", "backend", "jax_version",
+                   "interpret")
+
+
+class FingerprintMismatch(ValueError):
+    """Base and head runs come from incomparable environments."""
+
+
+def git_sha(root: str | None = None) -> str:
+    """Current git sha (short), ``TDT_GIT_SHA`` override for environments
+    without a work tree, "unknown" when neither resolves."""
+    env = os.environ.get("TDT_GIT_SHA")
+    if env:
+        return env
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], capture_output=True,
+            text=True, timeout=10,
+            cwd=root or os.path.dirname(os.path.abspath(__file__)))
+        if out.returncode == 0:
+            return out.stdout.strip()
+    except Exception:  # noqa: BLE001 — no git binary / not a repo
+        pass
+    return "unknown"
+
+
+def fingerprint(*, interpret: bool | None = None,
+                backend: str | None = None) -> dict:
+    """Environment fingerprint for a run record. Never raises: a host with
+    no initializable jax backend fingerprints as device_kind "none" —
+    still recordable, still comparable against other no-backend runs."""
+    import jax
+
+    try:
+        devs = jax.devices()
+        device_kind = devs[0].device_kind
+        world = len(devs)
+        backend = backend or devs[0].platform
+    except RuntimeError:
+        device_kind, world, backend = "none", 0, backend or "none"
+    if interpret is None:
+        try:
+            from triton_distributed_tpu.runtime.platform import on_tpu
+            interpret = not on_tpu()
+        except Exception:  # noqa: BLE001
+            interpret = True
+    return {
+        "device_kind": device_kind,
+        "world": world,
+        "backend": backend,
+        "jax_version": jax.__version__,
+        "git_sha": git_sha(),
+        "interpret": bool(interpret),
+    }
+
+
+def comparable(fp_a: dict, fp_b: dict) -> bool:
+    return all(fp_a.get(k) == fp_b.get(k) for k in COMPARABLE_KEYS)
+
+
+@dataclasses.dataclass
+class RunRecord:
+    """One recorded run: a flat metric dict plus identity."""
+
+    run_id: str
+    ts: float
+    suite: str
+    fingerprint: dict
+    metrics: dict
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"schema": SCHEMA_VERSION, **dataclasses.asdict(self)}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "RunRecord":
+        return cls(run_id=d["run_id"], ts=float(d["ts"]), suite=d["suite"],
+                   fingerprint=dict(d["fingerprint"]),
+                   metrics=dict(d["metrics"]), meta=dict(d.get("meta", {})))
+
+
+def _numeric_metrics(metrics: dict) -> dict:
+    """Keep finite numeric values only (bench extras mix strings like
+    ``ragged_k_best`` and error messages in with the numbers)."""
+    out = {}
+    for k, v in metrics.items():
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        if v != v or v in (float("inf"), float("-inf")):
+            continue
+        out[k] = float(v)
+    return out
+
+
+class PerfDB:
+    """Append-only JSONL database of RunRecords."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.skipped_lines = 0
+
+    # -- write --------------------------------------------------------------
+
+    def append(self, *, suite: str, metrics: dict,
+               fingerprint_: dict | None = None, meta: dict | None = None,
+               run_id: str | None = None, ts: float | None = None
+               ) -> RunRecord:
+        rec = RunRecord(
+            run_id=run_id or uuid.uuid4().hex[:12],
+            ts=time.time() if ts is None else ts,
+            suite=suite,
+            fingerprint=fingerprint_ if fingerprint_ is not None
+            else fingerprint(),
+            metrics=_numeric_metrics(metrics),
+            meta=meta or {})
+        d = os.path.dirname(os.path.abspath(self.path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(rec.as_dict(), sort_keys=True) + "\n")
+        return rec
+
+    # -- read ---------------------------------------------------------------
+
+    def runs(self, *, suite: str | None = None,
+             fingerprint_: dict | None = None) -> list[RunRecord]:
+        """All records (oldest first), optionally filtered by suite and by
+        comparability with ``fingerprint_``. Corrupt lines are skipped and
+        counted in ``self.skipped_lines``."""
+        out: list[RunRecord] = []
+        self.skipped_lines = 0
+        if not os.path.exists(self.path):
+            return out
+        with open(self.path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = RunRecord.from_dict(json.loads(line))
+                except (ValueError, KeyError, TypeError):
+                    self.skipped_lines += 1
+                    continue
+                if suite is not None and rec.suite != suite:
+                    continue
+                if (fingerprint_ is not None
+                        and not comparable(rec.fingerprint, fingerprint_)):
+                    continue
+                out.append(rec)
+        out.sort(key=lambda r: r.ts)
+        return out
+
+    def last(self, *, suite: str | None = None,
+             fingerprint_: dict | None = None) -> RunRecord | None:
+        rs = self.runs(suite=suite, fingerprint_=fingerprint_)
+        return rs[-1] if rs else None
+
+    def samples(self, metric: str, *, suite: str | None = None,
+                fingerprint_: dict | None = None) -> list[float]:
+        return [r.metrics[metric]
+                for r in self.runs(suite=suite, fingerprint_=fingerprint_)
+                if metric in r.metrics]
+
+
+# ---------------------------------------------------------------------------
+# Robust statistics + comparison
+# ---------------------------------------------------------------------------
+
+
+def lower_quartile(xs: list[float]) -> float:
+    """Same estimator as bench.py's slope filter: nearest-rank lower
+    quartile — the least-contended sample under one-sided noise."""
+    s = sorted(xs)
+    return s[max(0, (len(s) - 1) // 4)]
+
+
+def upper_quartile(xs: list[float]) -> float:
+    s = sorted(xs)
+    return s[min(len(s) - 1, (3 * (len(s) - 1) + 3) // 4)]
+
+
+_LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
+                       "retrace", "_failed", "achieved_over_bound",
+                       "queue_wait", "_ms_", "_error")
+_HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
+                        "speedup", "vs_baseline", "goodput", "ratio",
+                        "_completed", "requests_ok", "flops", "gbps")
+_LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
+
+
+def metric_direction(name: str) -> int:
+    """-1: lower is better (latency-like). +1: higher is better
+    (throughput/efficiency-like). 0: unknown — the gate reports these
+    informationally and never fails on them. Higher-better hints win
+    (``tokens_per_s`` ends with a latency suffix but is throughput);
+    latency SUFFIXES are endswith-only so ``roofline_sites`` stays
+    unknown instead of matching a ``_s`` substring."""
+    low = name.lower()
+    for hint in _HIGHER_BETTER_HINTS:
+        if hint in low:
+            return 1
+    if low.endswith(_LATENCY_SUFFIXES):
+        return -1
+    for hint in _LOWER_BETTER_HINTS:
+        if hint in low:
+            return -1
+    return 0
+
+
+def robust_anchor(xs: list[float], direction: int) -> float:
+    """Per-side anchor: best-observed quartile under one-sided noise (see
+    module docstring). Unknown-direction metrics anchor on the median."""
+    if direction < 0:
+        return lower_quartile(xs)
+    if direction > 0:
+        return upper_quartile(xs)
+    s = sorted(xs)
+    return s[len(s) // 2]
+
+
+@dataclasses.dataclass
+class Verdict:
+    """Per-metric comparison outcome."""
+
+    metric: str
+    status: str          # "regressed"|"improved"|"unchanged"|"new"|"gone"
+    direction: int
+    base: float | None
+    head: float | None
+    delta_frac: float | None   # signed: + means head worse, - means better
+    n_base: int
+    n_head: int
+    roofline: str = "unknown"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def compare(base_runs: list[RunRecord], head_runs: list[RunRecord], *,
+            tolerance: float = 0.08, metrics: list[str] | None = None,
+            check_fingerprints: bool = True) -> list[Verdict]:
+    """Per-metric verdicts for head vs base. Both sides anchor on their
+    best-observed quartile; ``delta_frac`` is signed so that POSITIVE
+    always means "head is worse" regardless of metric direction, and a
+    verdict regresses only beyond ``tolerance``. Unknown-direction metrics
+    never regress (status "unchanged" with the delta reported).
+
+    Refuses (``FingerprintMismatch``) when any pair of involved runs is
+    not environment-comparable — unless ``check_fingerprints=False``."""
+    if not base_runs or not head_runs:
+        raise ValueError("compare() needs at least one run on each side")
+    if check_fingerprints:
+        ref = base_runs[0].fingerprint
+        for r in (*base_runs, *head_runs):
+            if not comparable(ref, r.fingerprint):
+                diff = {k: (ref.get(k), r.fingerprint.get(k))
+                        for k in COMPARABLE_KEYS
+                        if ref.get(k) != r.fingerprint.get(k)}
+                raise FingerprintMismatch(
+                    f"run {r.run_id} not comparable to {base_runs[0].run_id}"
+                    f": {diff}")
+
+    def collect(runs: list[RunRecord]) -> dict[str, list[float]]:
+        col: dict[str, list[float]] = {}
+        for r in runs:
+            for k, v in r.metrics.items():
+                col.setdefault(k, []).append(v)
+        return col
+
+    base_col, head_col = collect(base_runs), collect(head_runs)
+    names = metrics or sorted(set(base_col) | set(head_col))
+
+    from triton_distributed_tpu.obs.roofline import metric_class
+
+    verdicts: list[Verdict] = []
+    for name in names:
+        direction = metric_direction(name)
+        b, h = base_col.get(name), head_col.get(name)
+        cls = metric_class(name)
+        if b and not h:
+            verdicts.append(Verdict(name, "gone", direction,
+                                    robust_anchor(b, direction), None, None,
+                                    len(b), 0, cls))
+            continue
+        if h and not b:
+            verdicts.append(Verdict(name, "new", direction, None,
+                                    robust_anchor(h, direction), None, 0,
+                                    len(h), cls))
+            continue
+        base_v = robust_anchor(b, direction)
+        head_v = robust_anchor(h, direction)
+        if base_v == 0:
+            delta = 0.0 if head_v == 0 else float("inf")
+        else:
+            raw = (head_v - base_v) / abs(base_v)
+            # Signed so + is always "worse": flip for higher-is-better.
+            delta = raw if direction <= 0 else -raw
+        if direction == 0:
+            status = "unchanged"
+        elif delta > tolerance:
+            status = "regressed"
+        elif delta < -tolerance:
+            status = "improved"
+        else:
+            status = "unchanged"
+        verdicts.append(Verdict(name, status, direction, base_v, head_v,
+                                delta, len(b), len(h), cls))
+    return verdicts

@@ -1,0 +1,199 @@
+"""Roofline attribution: was it fast, and what physically bounds it?
+
+The comm ledger (obs/comm_ledger.py) records *what happened* — wire bytes,
+call counts, achieved latency per (collective, axis) series. This module
+turns that into *whether it was fast*: it joins each ledger series with the
+``runtime/perf_model`` speeds-and-feeds table, computes the physical
+lower-bound time for the bytes the series moved, classifies the series as
+compute-, HBM-, or ICI-bound (whichever resource the bound saturates), and
+emits the per-site efficiency fraction
+
+    achieved_over_bound = achieved_s / bound_s      (>= 1.0; 1.0 == at the
+                                                     roofline)
+
+which is the number the perf gate (tools/perf_gate.py) attaches to every
+regression verdict: "gemm_rs regressed 18% and it is HBM-bound" is
+actionable; a bare delta is not.
+
+Bounds are LOWER bounds, deliberately cruder than the ``est_*`` latency
+models: ``est_*`` predicts what a good implementation should take
+(including protocol overheads), the bound here is what no implementation
+can beat (bytes over the binding pipe). ``achieved_over_est`` (ledger)
+answers "is the perf model honest"; ``achieved_over_bound`` (here) answers
+"how far from the hardware ceiling are we".
+
+The same classifier generalizes beyond collectives: ``classify_step``
+takes (flops, hbm_bytes, wall) for an engine/serving step, and
+``metric_class`` maps a bench metric NAME to its dominant-resource class
+so the gate can label metrics that carry no ledger data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_distributed_tpu.runtime import perf_model as pm
+
+# Per-collective HBM touch multiplier: every wire byte is at least read
+# from HBM once on the sender and written once at the receiver (2x); the
+# reducing collectives additionally pass the accumulator through HBM.
+_HBM_TOUCH = {
+    "all_gather": 2.0,
+    "reduce_scatter": 3.0,   # + fp32 accumulate read-modify-write
+    "all_reduce": 3.0,
+    "ep_all_to_all": 2.0,
+}
+_DEFAULT_TOUCH = 2.0
+
+
+@dataclasses.dataclass(frozen=True)
+class RooflineRecord:
+    """One ledger series (or step) joined against its physical bound."""
+
+    site: str                 # ledger series key / step name
+    collective: str
+    bound: str                # "ici" | "hbm" | "compute"
+    bound_s: float            # physical per-call lower bound, seconds
+    achieved_s: float | None  # mean wall per call; None if never timed
+    achieved_over_bound: float | None  # efficiency fraction (>= 1.0 ideal)
+    bytes_per_call: float
+    world: int
+    calls: int
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        for k in ("bound_s", "achieved_s", "achieved_over_bound"):
+            if d[k] is not None:
+                d[k] = round(d[k], 6)
+        return d
+
+
+def collective_bound(collective: str, *, nbytes: float, world: int,
+                     hw: pm.Hardware | None = None) -> tuple[str, float]:
+    """Physical per-call lower bound for moving ``nbytes`` wire bytes, and
+    the binding resource. ``world <= 1`` (loopback / degenerate axes) has
+    no wire: the traffic rides the local DMA engine through HBM."""
+    hw = hw or pm.detect_hardware()
+    touch = _HBM_TOUCH.get(collective, _DEFAULT_TOUCH)
+    hbm_s = touch * nbytes / hw.hbm_bw
+    if world <= 1:
+        return "hbm", hbm_s
+    # Aggregate ICI egress: the wire bytes leave over every wired link in
+    # parallel at best. The bisection refinement lives in est_*; the bound
+    # stays the unbeatable pipe rate.
+    ici_s = nbytes / (hw.ici_link_bw * hw.ici_links)
+    if ici_s >= hbm_s:
+        return "ici", ici_s
+    return "hbm", hbm_s
+
+
+def classify_step(*, flops: float, hbm_bytes: float, wall_s: float | None,
+                  name: str = "step",
+                  hw: pm.Hardware | None = None) -> RooflineRecord:
+    """Roofline-classify one compute step (engine decode/prefill, a GEMM
+    arm): bound is max(MXU time at peak, HBM traffic time); the larger
+    term names the binding resource."""
+    hw = hw or pm.detect_hardware()
+    compute_s = flops / hw.peak_bf16_flops
+    hbm_s = hbm_bytes / hw.hbm_bw
+    bound, bound_s = (("compute", compute_s) if compute_s >= hbm_s
+                      else ("hbm", hbm_s))
+    aob = None
+    if wall_s is not None and bound_s > 0:
+        aob = wall_s / bound_s
+    return RooflineRecord(site=name, collective=name, bound=bound,
+                          bound_s=bound_s, achieved_s=wall_s,
+                          achieved_over_bound=aob, bytes_per_call=hbm_bytes,
+                          world=1, calls=1)
+
+
+def attribute(snapshot: dict[str, dict] | None = None,
+              hw: pm.Hardware | None = None) -> dict[str, RooflineRecord]:
+    """Join a comm-ledger snapshot (``comm_ledger.snapshot()`` shape) with
+    the perf-model bounds: one RooflineRecord per ledger series. Series
+    that were only ever trace-time recorded carry ``achieved_s=None`` —
+    their byte accounting is still classified, there is just no wall clock
+    to form the efficiency fraction from."""
+    if snapshot is None:
+        from triton_distributed_tpu.obs import comm_ledger
+        snapshot = comm_ledger.snapshot()
+    hw = hw or pm.detect_hardware()
+    out: dict[str, RooflineRecord] = {}
+    for key, e in snapshot.items():
+        if not isinstance(e, dict) or "collective" not in e:
+            continue  # summary keys ride along in some snapshots
+        calls = int(e.get("calls", 0)) + int(e.get("traced_calls", 0))
+        if calls <= 0:
+            continue
+        nbytes = float(e.get("bytes_total", 0.0)) / calls
+        world = int(e.get("world", 1))
+        bound, bound_s = collective_bound(e["collective"], nbytes=nbytes,
+                                          world=world, hw=hw)
+        achieved = None
+        aob = None
+        if e.get("wall_samples"):
+            achieved = float(e["wall_s_total"]) / int(e["wall_samples"])
+            if bound_s > 0:
+                aob = achieved / bound_s
+        out[key] = RooflineRecord(
+            site=key, collective=e["collective"], bound=bound,
+            bound_s=bound_s, achieved_s=achieved, achieved_over_bound=aob,
+            bytes_per_call=nbytes, world=world, calls=calls)
+    return out
+
+
+def summary(records: dict[str, RooflineRecord] | None = None) -> dict:
+    """Flat aggregate over an ``attribute()`` result: counts per bound
+    class, the worst (highest achieved_over_bound) timed site, and the
+    mean efficiency fraction over timed sites. Empty dict when nothing
+    was timed AND nothing was recorded."""
+    if records is None:
+        records = attribute()
+    if not records:
+        return {}
+    timed = {k: r for k, r in records.items()
+             if r.achieved_over_bound is not None}
+    by_bound: dict[str, int] = {}
+    for r in records.values():
+        by_bound[r.bound] = by_bound.get(r.bound, 0) + 1
+    out: dict = {"sites": len(records), "by_bound": by_bound}
+    if timed:
+        worst_key = max(timed, key=lambda k: timed[k].achieved_over_bound)
+        out["timed_sites"] = len(timed)
+        out["mean_achieved_over_bound"] = round(
+            sum(r.achieved_over_bound for r in timed.values()) / len(timed),
+            4)
+        out["worst_site"] = worst_key
+        out["worst_achieved_over_bound"] = round(
+            timed[worst_key].achieved_over_bound, 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Metric-name classification — for bench/serve metrics that carry no
+# ledger series (the perf gate labels every verdict with one of these).
+# ---------------------------------------------------------------------------
+
+# Ordered (first match wins): specific families before generic suffixes.
+_METRIC_CLASS_RULES: tuple[tuple[tuple[str, ...], str], ...] = (
+    (("hbm_frac", "flash_decode", "weight_stream", "traffic_floor",
+      "moe_block", "staging_bound"), "hbm"),
+    (("a2a", "all_to_all", "ar_loopback", "ar_machinery", "allreduce",
+      "ag_staging", "oneshot", "ar_ratio", "dispatch_loopback"), "ici"),
+    (("ttft", "tbt", "queue", "serve_", "goodput", "recovery", "e2e",
+      "tokens_per_s", "preempt", "requests", "aot_", "coldstart"),
+     "serving"),
+    (("gemm", "matmul", "mlp", "fused", "flash_prefill", "attn",
+      "decode_ms", "pallas", "xla", "overlap"), "compute"),
+)
+
+
+def metric_class(name: str) -> str:
+    """Best-effort roofline class for a bench/serve metric NAME — used by
+    the perf gate to label verdicts for metrics with no ledger data.
+    Unmatched names classify as "unknown" (never guessed)."""
+    low = name.lower()
+    for needles, cls in _METRIC_CLASS_RULES:
+        if any(n in low for n in needles):
+            return cls
+    return "unknown"

@@ -45,7 +45,31 @@ _HW_TABLE = {
     "tpu v4": Hardware("v4", 275e12, 1228e9, 45e9, 6, 1e-6, 25e9, 10e-6),
     "tpu v6": Hardware("v6e", 918e12, 1640e9, 90e9, 4, 1e-6, 25e9, 10e-6),
 }
+# Marketing / short device_kind spellings (substring-matched AFTER the
+# canonical prefixes): bench.py's old private table matched these, so the
+# single source of truth must too.
+_KIND_ALIASES = {
+    "v5 lite": "tpu v5 lite", "v5lite": "tpu v5 lite", "v5e": "tpu v5 lite",
+    "v6 lite": "tpu v6", "v6e": "tpu v6",
+    "v5p": "tpu v5", "v5": "tpu v5",
+    "v4": "tpu v4", "v6": "tpu v6",
+}
 _DEFAULT_HW = _HW_TABLE["tpu v5 lite"]
+
+
+def match_hardware(kind: str) -> Hardware | None:
+    """Resolve a jax ``device_kind`` string to its speeds-and-feeds row, or
+    None when the kind is unknown (callers choose their own fallback:
+    ``detect_hardware`` falls back to v5e for crossovers, bench's
+    plausibility gate falls back LOOSE so it never rejects real samples)."""
+    kind = kind.lower()
+    for prefix, hw in sorted(_HW_TABLE.items(), key=lambda kv: -len(kv[0])):
+        if kind.startswith(prefix):
+            return hw
+    for alias, key in sorted(_KIND_ALIASES.items(), key=lambda kv: -len(kv[0])):
+        if alias in kind:
+            return _HW_TABLE[key]
+    return None
 
 
 @functools.cache
@@ -57,10 +81,37 @@ def detect_hardware() -> Hardware:
         kind = jax.devices()[0].device_kind.lower()
     except RuntimeError:
         return _DEFAULT_HW
-    for prefix, hw in sorted(_HW_TABLE.items(), key=lambda kv: -len(kv[0])):
-        if kind.startswith(prefix):
-            return hw
-    return _DEFAULT_HW
+    return match_hardware(kind) or _DEFAULT_HW
+
+
+def peak_bf16_tflops(kind: str | None = None, *, tolerance: float = 1.0,
+                     default: float | None = None) -> float:
+    """Per-chip bf16 peak in TF/s — the single source of truth behind
+    bench.py's slope plausibility filter AND the roofline compute bound
+    (two drifting tables once disagreed 4x on the unknown-device fallback).
+
+    ``tolerance`` scales the peak (bench passes 1.02: measurement slack so
+    a 199 TF/s sample on a 197-peak v5e is not rejected). ``default`` is
+    returned UNSCALED for unknown kinds when given (bench passes 1000.0 —
+    loose beats wrongly rejecting every sample); otherwise unknown kinds
+    fall back to the v5e figure."""
+    if kind is None:
+        try:
+            kind = jax.devices()[0].device_kind
+        except RuntimeError:
+            kind = ""
+    hw = match_hardware(kind)
+    if hw is None:
+        if default is not None:
+            return default
+        hw = _DEFAULT_HW
+    return hw.peak_bf16_flops / 1e12 * tolerance
+
+
+def hbm_gbps(hw: Hardware | None = None) -> float:
+    """Per-chip HBM bandwidth in GB/s (same table; convenience unit for the
+    ms-scale roofline arithmetic bench.py and obs/roofline.py do)."""
+    return (hw or detect_hardware()).hbm_bw / 1e9
 
 
 # ---------------------------------------------------------------------------

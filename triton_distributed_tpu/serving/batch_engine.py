@@ -242,6 +242,29 @@ class BatchEngine:
             "metrics": self.metrics.as_dict(),
         }
 
+    def perfdb_sample(self) -> dict:
+        """Flat metric dict for the perf flight recorder (obs/perfdb.py):
+        the serving-side tracked numbers — TTFT/TBT/e2e percentiles in ms,
+        token/request counters, preemptions, retraces. Callers append this
+        as one PerfDB run (``scripts/serve_smoke.py --perfdb``, bench's
+        serve arms) so ``tools/perf_gate.py`` can gate on serving latency
+        the same way it gates on kernel time."""
+        m = self.metrics.as_dict()
+        out: dict = {}
+        for hist in ("ttft_s", "tbt_s", "e2e_latency_s", "queue_wait_s"):
+            for stat in ("p50", "p95"):
+                k = f"{hist}_{stat}"
+                if k in m:
+                    out[f"{hist[:-2]}_{stat}_ms"] = round(
+                        float(m[k]) * 1e3, 3)
+        for k in ("tokens_generated", "requests_completed",
+                  "requests_failed", "preemptions", "step_retries"):
+            if k in m:
+                out[k] = float(m[k])
+        out["retraces"] = max(0.0, float(self.trace_counts["decode"]
+                                         + self.trace_counts["prefill"] - 2))
+        return out
+
     def _call_step(self, site: str, fn):
         """Dispatch one compiled step through the fault plane + retry.
 
