@@ -386,10 +386,108 @@ def _run_cpu_fallback(reason: str) -> dict:
     return result
 
 
+def _bench_paged_attn() -> dict:
+    """The ``--paged-attn`` arm: fused block-walk decode attention vs the
+    gather-materialization fallback.
+
+    The headline number is the analytic HBM byte RATIO
+    (``perf_model.paged_attn_bytes`` fused / gather — what the kernels'
+    ``cost_estimate.bytes_accessed`` is built from), which is deterministic
+    and platform-independent, so the perf gate can hold the ≤ ~0.55
+    acceptance bar anywhere (CPU CI included). The arm also actually RUNS
+    both paths (interpret mode off-TPU) on a churned pool — ragged
+    ``kv_lens``, shuffled non-identity block table, one dead slot — and
+    reports the max |fused - gather| divergence plus the comm ledger's
+    ``paged_attn`` series with its roofline class, so a routing or masking
+    regression shows up as data, not just as bytes.
+    """
+    import numpy as np
+
+    from triton_distributed_tpu.layers import nn
+    from triton_distributed_tpu.obs import comm_ledger, roofline
+    from triton_distributed_tpu.runtime import perf_model as pm
+
+    B, bs, Hkv, g, dh, max_blocks = 4, 8, 2, 2, 16, 4
+    Hq = Hkv * g
+    n_blocks = B * max_blocks + 2
+    rng = np.random.default_rng(0)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, 1, Hq, dh)), jnp.float32)
+    tables = jnp.asarray(
+        rng.permutation(n_blocks)[:B * max_blocks].reshape(B, max_blocks),
+        jnp.int32)
+    offset = jnp.asarray(rng.integers(0, max_blocks * bs, size=B), jnp.int32)
+    slot_mask = jnp.asarray([True] * (B - 1) + [False])
+
+    with comm_ledger.ledger(reset_first=True):
+        outs = {
+            m: nn.paged_attn_with_cache(
+                q, kp, vp, tables, offset, scale=dh ** -0.5,
+                slot_mask=slot_mask, paged_attn=m)
+            for m in ("fused", "gather")
+        }
+        snap = comm_ledger.snapshot()
+    live = slice(0, B - 1)   # the dead slot's row is garbage by contract
+    max_err = float(jnp.max(jnp.abs(outs["fused"][live]
+                                    - outs["gather"][live])))
+
+    shape_kw = dict(n_q_heads=Hq, itemsize=kp.dtype.itemsize)
+    fused_b = pm.paged_attn_bytes(B, max_blocks, bs, Hkv, dh,
+                                  method="fused", **shape_kw)
+    gather_b = pm.paged_attn_bytes(B, max_blocks, bs, Hkv, dh,
+                                   method="gather", **shape_kw)
+    series = {d["method"]: d for d in snap.values()
+              if isinstance(d, dict) and d.get("collective") == "paged_attn"}
+    extras = {
+        "paged_attn_fused_bytes": int(fused_b),
+        "paged_attn_gather_bytes": int(gather_b),
+        "paged_attn_max_abs_err": round(max_err, 8),
+        "paged_attn_roofline_class": roofline.metric_class(
+            "paged_attn_bytes_ratio"),
+        "paged_attn_ledger_methods": sorted(series),
+        "paged_attn_ledger_bytes_match": bool(
+            series.get("fused", {}).get("bytes_total") == fused_b
+            and series.get("gather", {}).get("bytes_total") == gather_b),
+    }
+    if max_err > 2e-5:
+        raise RuntimeError(
+            f"fused/gather divergence {max_err} exceeds f32 tolerance")
+    if not extras["paged_attn_ledger_bytes_match"]:
+        raise RuntimeError(
+            f"ledger bytes disagree with perf_model.paged_attn_bytes: "
+            f"{series}")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "paged_attn_bytes_ratio",
+        "value": round(fused_b / gather_b, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
     perfdb_path = _arg_after(sys.argv, "--perfdb")
+
+    # --paged-attn: fused vs gather paged-decode byte ratio + routing
+    # check. BEFORE the backend probe: the arm runs anywhere (interpret
+    # mode off-TPU) and its headline ratio is analytic, so CPU CI gates it.
+    if "--paged-attn" in sys.argv:
+        try:
+            result = _bench_paged_attn()
+        except Exception as e:  # noqa: BLE001
+            result = {
+                "backend": "error",
+                "metric": "paged_attn_bytes_ratio",
+                "value": None,
+                "unit": "frac",
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }
+        print(json.dumps(result))
+        _record_perfdb(result, perfdb_path, suite="paged_attn")
+        return
 
     # Backend probe FIRST: everything below (compile cache, device queries)
     # assumes a live backend. A failed TPU/axon init becomes a structured

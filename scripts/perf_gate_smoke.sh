@@ -7,7 +7,10 @@
 #   2. python bench.py --perfdb          -> bench run (cpu-fallback on a
 #                                           no-TPU host, by design: this
 #                                           smoke must pass anywhere)
-#   3. tools/perf_gate.py --db ...       -> compare newest vs history,
+#   3. python bench.py --paged-attn      -> fused-vs-gather paged decode
+#                                           byte ratio (analytic, runs
+#                                           anywhere; hard-checked <= 0.55)
+#   4. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -54,6 +57,22 @@ assert "backend" in obj and "metric" in obj, sorted(obj)
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: paged_attn run $i/2" >&2
+  python bench.py --paged-attn --perfdb "$DB" \
+    > "$WORKDIR/paged_attn_out.$i.json"
+  python - "$WORKDIR/paged_attn_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+# The byte-ratio acceptance bar: fused must stay at or under ~55% of the
+# gather path's HBM bill (ISSUE 5). Analytic, so it is exact, not noisy.
+assert obj["value"] is not None and obj["value"] <= 0.55, obj["value"]
+EOF
+done
+
 echo "perf_gate_smoke: gating serve_smoke suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_smoke \
   --tolerance "$TOL" --report "$WORKDIR/serve_report.md"
@@ -61,5 +80,9 @@ python tools/perf_gate.py --db "$DB" --suite serve_smoke \
 echo "perf_gate_smoke: gating bench suite" >&2
 python tools/perf_gate.py --db "$DB" --suite bench \
   --tolerance "$TOL" --report "$WORKDIR/bench_report.md"
+
+echo "perf_gate_smoke: gating paged_attn suite" >&2
+python tools/perf_gate.py --db "$DB" --suite paged_attn \
+  --tolerance "$TOL" --report "$WORKDIR/paged_attn_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

@@ -1,0 +1,339 @@
+"""Fused paged-attention decode: walk the block table INSIDE the kernel.
+
+The serving decode path used to read the block-paged KV pool through
+``sp_attention.paged_gather_kv``, which materializes a contiguous
+``(B, max_blocks * block_size, Hkv, dh)`` copy of BOTH K and V every decode
+step, every layer, before attention runs — the pool bytes are read once to
+build the view, written once into it, and read again by the kernel: ~3x the
+KV HBM traffic of a single pass. This module is the Pallas upgrade path the
+gather docstring promised (and the move vLLM's PagedAttention / Flash-
+Decoding make): the kernel receives the block table via scalar prefetch,
+DMA-copies each sequence's pool blocks straight into VMEM staging, and runs
+the streaming-softmax accumulation of ``_flash_decode_kernel`` over the
+block grid — decode attention becomes HBM-bound on the VALID cache bytes
+only, with no materialized dense view at all.
+
+Scope: the single-token DECODE step (L == 1, the hot serving loop). Mixed /
+chunked-prefill steps keep the documented gather fallback
+(``layers.nn.paged_attn_with_cache`` routes them): a prefill chunk re-reads
+the whole prefix anyway, so the gather's extra pass is amortized over
+``prefill_chunk`` tokens there, while on the decode path it doubles the
+per-token bill — exactly where this kernel earns its bytes.
+
+Grid: ``(B, n_tiles)`` with ``n_tiles = ceil(max_blocks / tile_blocks)``;
+the tile dimension is ``arbitrary`` (sequential) so the running
+(acc, max, denom) triple carries across tiles. Tiles entirely past a slot's
+``kv_len`` skip their DMAs AND their math (``pl.when`` on the scalar-
+prefetched length) — a short sequence in a long-table batch costs only its
+own bytes. Dead slots are routed to block 0 on the HOST (same semantics as
+the gather path) and their outputs discarded by the caller.
+
+The block-grid tile size is a ``ContextualAutotuner`` config keyed on
+(block_size, Hkv, dh, max_blocks, dtype) — ``tuned_paged_tile`` — with a
+VMEM-bounded heuristic default off-TPU / under trace.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.runtime.platform import on_tpu, resolve_interpret
+
+_NEG_INF = -1e30
+
+
+# ---------------------------------------------------------------------------
+# Block-grid tile autotuning
+# ---------------------------------------------------------------------------
+
+# Candidate tile sizes (pool blocks staged per grid step). Preference order:
+# the VMEM-bounded heuristic winner is inserted first by tuned_paged_tile, so
+# off-TPU and trace-time callers get it deterministically.
+_TILE_CANDIDATES = (8, 16, 4, 2, 1, 32)
+
+
+def _feasible_tiles(block_size: int, n_kv_heads: int, head_dim: int,
+                    max_blocks: int, itemsize: int) -> list[int]:
+    """Candidate tiles whose double (K+V) VMEM staging fits the collective
+    staging budget, capped at the table width; heuristic default first
+    (largest feasible tile staging <= 512 cache rows — enough DMA depth to
+    pipeline against the MXU without hogging VMEM, the flash-decode chunk
+    preference applied to blocks)."""
+    per_block = 2 * block_size * n_kv_heads * head_dim * itemsize
+    ok = [t for t in _TILE_CANDIDATES
+          if t <= max(1, max_blocks)
+          and t * per_block <= common.VMEM_STAGE_BUDGET]
+    if not ok:
+        ok = [1]
+    default = max((t for t in ok if t * block_size <= 512), default=min(ok))
+    return [default] + [t for t in sorted(ok, reverse=True) if t != default]
+
+
+def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
+                     max_blocks: int, dtype_str: str = "bfloat16") -> int:
+    """Block-grid tile size for ``paged_decode_attention``, contextual-
+    autotuner cached per (block_size, Hkv, dh, max_blocks, dtype).
+
+    Off-TPU or under an active jax trace the tuner never times: a cached
+    winner is used if one exists, else the VMEM-bounded heuristic default is
+    returned UNCOMMITTED (the autotuner commit discipline —
+    runtime/autotuner.py ``_tune_matmul_blocks``). On a real TPU an eager
+    call tunes the candidates over a synthetic pool at the live geometry
+    with the interleaved slope timer.
+    """
+    from triton_distributed_tpu.runtime.autotuner import (
+        ContextualAutotuner,
+        _memoized_blocks,
+        _memory_cache,
+        _trace_state_clean,
+        interleaved_slope_timer,
+    )
+
+    itemsize = jnp.dtype(dtype_str).itemsize
+    cands = _feasible_tiles(block_size, n_kv_heads, head_dim, max_blocks,
+                            itemsize)
+    if len(cands) == 1:
+        return cands[0]
+    tuner = ContextualAutotuner("paged_attn_tile", cands,
+                                multi_timer=interleaved_slope_timer)
+    ctx = f"bs{block_size}:h{n_kv_heads}:d{head_dim}:mb{max_blocks}:{dtype_str}"
+
+    if not on_tpu() or not _trace_state_clean():
+        cached = tuner.peek(ctx)
+        return cached if cached is not None else cands[0]
+
+    def compute():
+        B, g = 8, 2
+        dtype = jnp.dtype(dtype_str)
+        n_blocks = B * max_blocks
+        key = jax.random.PRNGKey(0)
+        kp = jax.random.normal(
+            key, (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
+        vp = jax.random.normal(
+            jax.random.fold_in(key, 1),
+            (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
+        q = jax.random.normal(
+            jax.random.fold_in(key, 2),
+            (B, n_kv_heads * g, head_dim)).astype(dtype)
+        tables = jnp.arange(B * max_blocks, dtype=jnp.int32).reshape(
+            B, max_blocks)
+        kv_lens = jnp.full((B,), max_blocks * block_size, jnp.int32)
+
+        def make_loop(tile):
+            @jax.jit
+            def loop(q, n_iter):
+                def body(_, acc):
+                    out = paged_decode_attention(
+                        acc.astype(q.dtype), kp, vp, tables, kv_lens,
+                        tile_blocks=tile)
+                    return out.astype(jnp.float32)
+                return jax.lax.fori_loop(0, n_iter, body,
+                                         q.astype(jnp.float32))
+
+            loop(q, jnp.int32(2)).block_until_ready()
+            return lambda n_iter: loop(q, jnp.int32(n_iter))
+
+        cfg = tuner.tune(make_loop, ctx)
+        # tune() returns config 0 UNCACHED when every candidate timed out —
+        # the memoized result must mirror that so a later call re-tunes.
+        return cfg, tuner._key(ctx) in _memory_cache
+
+    return _memoized_blocks(("paged_tile", block_size, n_kv_heads, head_dim,
+                             max_blocks, dtype_str), compute)
+
+
+# ---------------------------------------------------------------------------
+# The kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
+                         k_buf, v_buf, acc_ref, m_ref, l_ref, sems, *,
+                         n_tiles: int, tile_blocks: int, bs: int,
+                         n_blocks: int, scale: float, n_kv: int):
+    """One (slot, block-tile) grid step of fused paged decode attention.
+
+    ``tbl_ref`` (B, max_blocks) int32 and ``kvlen_ref`` (B,) int32 arrive
+    via scalar prefetch (SMEM — readable before any DMA is issued, which is
+    the whole trick: the block ids ARE the gather, resolved in-kernel).
+    K/V pools stay in ANY/HBM; each tile DMA-copies its ``tile_blocks``
+    pool blocks into VMEM staging and runs the ``_flash_decode_kernel``
+    streaming-softmax update per kv head over the staged rows. Blocks past
+    ``kv_len`` skip their DMA entirely; the position mask zeroes whatever
+    stale staging rows the skipped fetch left behind (``jnp.where`` before
+    the max and the ``* valid`` guard on p scrub any NaN/Inf garbage).
+    """
+    b = pl.program_id(0)
+    t = pl.program_id(1)
+    kv_len = kvlen_ref[b]
+    base = t * tile_blocks * bs
+
+    @pl.when(t == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(base < kv_len)
+    def _work():
+        # In-kernel block walk: the gather, without the materialized view.
+        for i in range(tile_blocks):
+            @pl.when(base + i * bs < kv_len)
+            def _fetch(i=i):
+                # Same defensive clamp as the gather path's mode="clip".
+                blk = jnp.clip(tbl_ref[b, t * tile_blocks + i], 0,
+                               n_blocks - 1)
+                common.local_copy(kp_ref.at[blk],
+                                  k_buf.at[pl.ds(i * bs, bs)], sems.at[0])
+                common.local_copy(vp_ref.at[blk],
+                                  v_buf.at[pl.ds(i * bs, bs)], sems.at[1])
+
+        # Staging rows whose block was never fetched hold garbage (NaN in
+        # interpret mode, stale VMEM on hardware). The score-side position
+        # mask scrubs stale K (a masked score is overwritten), but stale V
+        # flows through the PV dot where ``0 * NaN = NaN`` — zero the dead
+        # rows explicitly before contracting.
+        row_pos = base + jax.lax.broadcasted_iota(
+            jnp.int32, (tile_blocks * bs, 1), 0)
+        row_live = row_pos < kv_len                          # (T*bs, 1) bool
+
+        for h in range(n_kv):
+            # f32 casts deliberate — see _flash_decode_kernel: bf16 g-row
+            # sub-tiles hit Mosaic's relayout path and measured slower.
+            q = q_ref[0, h].astype(jnp.float32)              # (g, dh)
+            k = k_buf[:, h, :].astype(jnp.float32)           # (T*bs, dh)
+            # where, not multiply: 0 * NaN is still NaN.
+            v = jnp.where(row_live, v_buf[:, h, :].astype(jnp.float32), 0.0)
+            scores = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ()))) * scale      # (g, T*bs)
+            pos = base + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+            valid = pos < kv_len
+            scores = jnp.where(valid, scores, _NEG_INF)
+            seg_max = jnp.max(scores, axis=-1, keepdims=True)
+            new_max = jnp.maximum(m_ref[h], seg_max)
+            corr = jnp.exp(m_ref[h] - new_max)
+            # ``* valid``: a fully-masked tail has scores == new_max ==
+            # _NEG_INF and exp(0) == 1 would poison the denominator.
+            p = jnp.exp(scores - new_max) * valid.astype(jnp.float32)
+            l_ref[h] = l_ref[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())))              # (g, dh)
+            m_ref[h] = new_max
+
+    @pl.when(t == n_tiles - 1)
+    def _finish():
+        denom = jnp.maximum(l_ref[...], 1e-30)               # (n_kv, g, 1)
+        o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
+
+
+def paged_attn_cost(B: int, max_blocks: int, block_size: int,
+                    n_kv_heads: int, head_dim: int, *, n_q_heads: int,
+                    itemsize: int = 2):
+    """The fused kernel's cost estimate — ONE pass over the (worst-case
+    full-table) pool bytes plus q in wire dtype and the f32 out. The
+    acceptance comparison against the gather path's 3x KV bill lives in
+    ``runtime.perf_model.paged_attn_bytes`` (same arithmetic, both
+    methods)."""
+    kv = 2 * B * max_blocks * block_size * n_kv_heads * head_dim * itemsize
+    return common.cost_estimate(
+        flops=4 * B * n_q_heads * max_blocks * block_size * head_dim,
+        bytes_accessed=B * n_q_heads * head_dim * (itemsize + 4) + kv)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
+                           slot_mask=None, scale: float | None = None,
+                           tile_blocks: int | None = None, interpret=None):
+    """GQA decode attention directly over a block-paged KV pool.
+
+    q:            (B, Hq, dh) — one new (rope'd) query row per slot.
+    k/v_pool:     (n_blocks, block_size, Hkv, dh) — ONE layer of this
+                  device's kv-head shard of ``serving.kv_pool.PagedKVState``
+                  (the new token's K/V already written via
+                  ``nn.paged_cache_update``).
+    block_tables: (B, max_blocks) int32 — slot b's sequence occupies blocks
+                  ``block_tables[b, :ceil(kv_lens[b]/block_size)]`` in
+                  order; tail entries are allocator padding (never read:
+                  their tiles skip the DMA).
+    kv_lens:      () or (B,) int32 — valid cache length per slot INCLUDING
+                  the token just written (decode step: ``offset + 1``).
+    slot_mask:    (B,) bool or None — dead slots' table rows are routed to
+                  block 0 (the gather path's semantics: stale table entries
+                  may point at blocks since reallocated to live sequences;
+                  the mask keeps a dead slot from touching them at all).
+                  The dead rows' outputs are garbage the caller discards.
+    tile_blocks:  pool blocks staged per grid step (None = autotuned /
+                  heuristic, ``tuned_paged_tile``).
+
+    Returns (B, Hq, dh) in q.dtype. Bit-compatible with the reference
+    ``paged_gather_kv`` + dense/flash decode composition (streaming softmax
+    over the same masked positions); verified greedy-token-identical in
+    tests/test_paged_attention.py.
+    """
+    B, Hq, dh = q.shape
+    n_blocks, bs, Hkv, _ = k_pool.shape
+    if Hq % Hkv:
+        raise ValueError(f"q heads {Hq} not divisible by kv heads {Hkv}")
+    if block_tables.dtype != jnp.int32:
+        raise TypeError(
+            f"block_tables must be int32 (got {block_tables.dtype}): the "
+            f"scalar-prefetch index path does no implicit cast, and a "
+            f"float/int64 table silently truncating would read the wrong "
+            f"blocks")
+    _, max_blocks = block_tables.shape
+    g = Hq // Hkv
+    scale = dh ** -0.5 if scale is None else scale
+    if slot_mask is not None:
+        block_tables = jnp.where(slot_mask[:, None], block_tables, 0)
+    kv_lens = jnp.broadcast_to(
+        jnp.asarray(kv_lens, jnp.int32).reshape(-1), (B,))
+    if tile_blocks is None:
+        tile_blocks = tuned_paged_tile(bs, Hkv, dh, max_blocks,
+                                       str(k_pool.dtype))
+    tile_blocks = max(1, min(tile_blocks, max_blocks))
+    n_tiles = pl.cdiv(max_blocks, tile_blocks)
+    # Pad the table on the right so the last tile's static fetch loop can
+    # index it; padded entries sit past every kv_len and never DMA.
+    pad = n_tiles * tile_blocks - max_blocks
+    if pad:
+        block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
+
+    qg = q.reshape(B, Hkv, g, dh)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, n_tiles),
+        in_specs=[
+            pl.BlockSpec((1, Hkv, g, dh), lambda b, t, tbl, kl: (b, 0, 0, 0)),
+            common.any_spec(),     # k pool: manual per-block DMA
+            common.any_spec(),     # v pool
+        ],
+        out_specs=pl.BlockSpec((1, Hkv, g, dh),
+                               lambda b, t, tbl, kl: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((tile_blocks * bs, Hkv, dh), k_pool.dtype),  # k stage
+            pltpu.VMEM((tile_blocks * bs, Hkv, dh), v_pool.dtype),  # v stage
+            pltpu.VMEM((Hkv, g, dh), jnp.float32),   # acc
+            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # running max
+            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # denominator
+            common.dma_sems(2),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, n_tiles=n_tiles,
+                          tile_blocks=tile_blocks, bs=bs, n_blocks=n_blocks,
+                          scale=scale, n_kv=Hkv),
+        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, dh), jnp.float32),
+        grid_spec=grid_spec,
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        cost_estimate=paged_attn_cost(
+            B, max_blocks, bs, Hkv, dh, n_q_heads=Hq,
+            itemsize=k_pool.dtype.itemsize),
+        interpret=resolve_interpret(interpret),
+    )(block_tables, kv_lens, qg, k_pool, v_pool)
+    return out.reshape(B, Hq, dh).astype(q.dtype)

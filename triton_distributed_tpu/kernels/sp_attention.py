@@ -816,15 +816,26 @@ def paged_gather_kv(pool, block_tables, *, slot_mask=None):
     positions >= the slot offset), but the mask keeps a dead slot from
     touching live sequences' blocks at all.
 
-    Decode attention reads the whole valid cache regardless of layout, so
-    the gather adds no asymptotic HBM traffic over the contiguous path; a
-    fused in-kernel block walk (index-map over the table, skipping the
-    gather materialization) is the Pallas upgrade path.
+    This is now the REFERENCE / fallback read path: single-token decode
+    routes through the fused in-kernel block walk
+    (``kernels.paged_attention.paged_decode_attention`` — no materialized
+    view, one pass over the pool bytes) via ``nn.paged_attn_with_cache``;
+    the gather stays for mixed/chunked-prefill steps (the extra pass
+    amortizes over the chunk) and as the ``paged_attn="gather"`` escape
+    hatch the fused kernel is verified token-identical against.
     """
+    if block_tables.dtype != jnp.int32:
+        raise TypeError(
+            f"block_tables must be int32 (got {block_tables.dtype}): the "
+            f"allocator emits int32 tables (KVPool.padded_tables) and a "
+            f"float/int64 table silently cast here would gather the wrong "
+            f"blocks")
     B, nb = block_tables.shape
     if slot_mask is not None:
         block_tables = jnp.where(slot_mask[:, None], block_tables, 0)
-    g = jnp.take(pool, block_tables.reshape(-1), axis=0)   # clamp OOB
+    # mode="clip" makes the OOB policy explicit (jnp.take's default today,
+    # but the correctness of padded/stale table entries rests on it).
+    g = jnp.take(pool, block_tables.reshape(-1), axis=0, mode="clip")
     return g.reshape(B, nb * pool.shape[1], *pool.shape[2:])
 
 

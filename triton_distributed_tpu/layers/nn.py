@@ -209,6 +209,78 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
     return out.reshape(B, L, Hq, dh).astype(q.dtype)
 
 
+def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
+                          scale: float, slot_mask=None,
+                          use_flash_decode: bool = True, seq_lens=None,
+                          interpret=None, paged_attn: str = "fused"):
+    """GQA attention of new queries against a BLOCK-PAGED KV pool — the
+    paged twin of ``attn_with_cache``, and the router between the fused
+    Pallas kernel and the gather fallback.
+
+    The single-token decode step (L == 1, no ``seq_lens``) routes through
+    ``kernels.paged_attention.paged_decode_attention``: the kernel walks the
+    scalar-prefetched block table itself, so the pool bytes are read ONCE —
+    no materialized ``(B, max_blocks*block_size, Hkv, dh)`` view. Mixed /
+    chunked-prefill steps (L > 1 or ragged ``seq_lens``) keep the documented
+    gather fallback (``paged_gather_kv`` + ``attn_with_cache``): a prefill
+    chunk re-reads the whole prefix anyway, so the gather's extra pass
+    amortizes over the chunk there, while on the decode path it triples the
+    per-token KV bill. ``paged_attn="gather"`` forces the fallback
+    everywhere (the escape hatch / reference path the fused kernel is
+    verified greedy-token-identical against).
+
+    q:            (B, L, Hq, dh) new queries (rope'd); the new tokens' K/V
+                  are already in the pool (``paged_cache_update`` runs
+                  first).
+    k/v_pool:     (n_blocks, block_size, Hkv, dh) one layer of the pool.
+    block_tables: (B, max_blocks) int32; offset: () or (B,) cache length
+    BEFORE this step; slot_mask: (B,) bool dead-slot mask (dead rows'
+    outputs are garbage the serving engine discards). -> (B, L, Hq, dh).
+
+    When the comm ledger is enabled, records a ``paged_attn`` series with
+    the analytic ``perf_model.paged_attn_bytes`` for whichever method ran —
+    the roofline classifies it HBM-bound (one pool touch), and the bench
+    ``paged_attn`` arm gates the fused/gather byte ratio.
+    """
+    if paged_attn not in ("fused", "gather"):
+        raise ValueError(
+            f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
+    B, L, Hq, dh = q.shape
+    fused = paged_attn == "fused" and L == 1 and seq_lens is None
+
+    from triton_distributed_tpu.obs import comm_ledger as _ledger
+
+    if _ledger.enabled():
+        from triton_distributed_tpu.runtime import perf_model as pm
+
+        method = "fused" if fused else "gather"
+        nbytes = pm.paged_attn_bytes(
+            B, block_tables.shape[1], k_pool.shape[1], k_pool.shape[2], dh,
+            n_q_heads=Hq, itemsize=k_pool.dtype.itemsize, method=method)
+        _ledger.record_traced(
+            "paged_attn", axis="local", world=1, nbytes=nbytes,
+            method=method, est_s=nbytes / pm.detect_hardware().hbm_bw)
+
+    if fused:
+        from triton_distributed_tpu.kernels.paged_attention import (
+            paged_decode_attention,
+        )
+
+        out = paged_decode_attention(
+            q.reshape(B, Hq, dh), k_pool, v_pool, block_tables,
+            jnp.asarray(offset, jnp.int32) + 1, slot_mask=slot_mask,
+            scale=scale, interpret=interpret)
+        return out.reshape(B, 1, Hq, dh)
+
+    from triton_distributed_tpu.kernels.sp_attention import paged_gather_kv
+
+    k_view = paged_gather_kv(k_pool, block_tables, slot_mask=slot_mask)
+    v_view = paged_gather_kv(v_pool, block_tables, slot_mask=slot_mask)
+    return attn_with_cache(q, k_view, v_view, offset, scale=scale,
+                           use_flash_decode=use_flash_decode,
+                           seq_lens=seq_lens, interpret=interpret)
+
+
 def cache_update(cache, new, offset):
     """Write ``new`` (B, L, H, dh) into ``cache`` (B, S, H, dh) at ``offset``
     along the sequence dim. Functional: returns the new cache array.

@@ -130,7 +130,8 @@ class TPAttn:
 
     def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world,
                      use_flash_decode: bool = True, seq_lens=None,
-                     interpret=None, block_tables=None, slot_mask=None):
+                     interpret=None, block_tables=None, slot_mask=None,
+                     paged_attn: str = "fused"):
         """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
         output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
         cache-append -> GQA-attend pipeline shared by every mode
@@ -146,10 +147,15 @@ class TPAttn:
           (n_blocks, block_size, Hkv, dh); ``block_tables`` (B, max_blocks)
           maps each slot's sequence onto pool blocks, ``offset`` is the
           (B,) per-slot depth vector, and ``slot_mask`` (B,) drops dead
-          slots' cache writes. New K/V scatter into the pool, attention
-          reads through a block-table gather (sp_attention.paged_gather_kv)
-          — so arriving/finishing sequences are pure DATA changes and the
-          step never retraces.
+          slots' cache writes. New K/V scatter into the pool; attention
+          reads back through ``nn.paged_attn_with_cache``, which routes
+          decode steps to the fused Pallas block-walk kernel
+          (``paged_attn="fused"``, the default — one pool pass, no
+          materialized view; NOTE it wins over ``use_flash_decode=False``,
+          so the xla golden mode exercises the same fused kernel) and
+          mixed/prefill steps (or ``paged_attn="gather"``) to the
+          paged_gather_kv fallback — either way arriving/finishing
+          sequences are pure DATA changes and the step never retraces.
         """
         B, L, _ = qkv.shape
         qs, kvs = self.sizes(world)
@@ -170,40 +176,39 @@ class TPAttn:
         if block_tables is None:
             k_cache = nn.cache_update(k_cache, k, offset)
             v_cache = nn.cache_update(v_cache, v, offset)
-            k_view, v_view = k_cache, v_cache
-        else:
-            from triton_distributed_tpu.kernels.sp_attention import (
-                paged_gather_kv,
-            )
+            out = nn.attn_with_cache(q, k_cache, v_cache, offset,
+                                     scale=dh ** -0.5,
+                                     use_flash_decode=use_flash_decode,
+                                     seq_lens=seq_lens, interpret=interpret)
+            return out.reshape(B, L, qs), k_cache, v_cache
 
-            wm = slot_mask                              # (B,) or None
-            if seq_lens is not None:
-                tok_valid = jnp.arange(L)[None] < seq_lens[:, None]
-                wm = tok_valid if wm is None else (wm[:, None] & tok_valid)
-            k_cache = nn.paged_cache_update(k_cache, k, block_tables,
-                                            offset, wm)
-            v_cache = nn.paged_cache_update(v_cache, v, block_tables,
-                                            offset, wm)
-            k_view = paged_gather_kv(k_cache, block_tables,
-                                     slot_mask=slot_mask)
-            v_view = paged_gather_kv(v_cache, block_tables,
-                                     slot_mask=slot_mask)
-        out = nn.attn_with_cache(q, k_view, v_view, offset,
-                                 scale=dh ** -0.5,
-                                 use_flash_decode=use_flash_decode,
-                                 seq_lens=seq_lens, interpret=interpret)
+        wm = slot_mask                              # (B,) or None
+        if seq_lens is not None:
+            tok_valid = jnp.arange(L)[None] < seq_lens[:, None]
+            wm = tok_valid if wm is None else (wm[:, None] & tok_valid)
+        k_cache = nn.paged_cache_update(k_cache, k, block_tables,
+                                        offset, wm)
+        v_cache = nn.paged_cache_update(v_cache, v, block_tables,
+                                        offset, wm)
+        out = nn.paged_attn_with_cache(q, k_cache, v_cache, block_tables,
+                                       offset, scale=dh ** -0.5,
+                                       slot_mask=slot_mask,
+                                       use_flash_decode=use_flash_decode,
+                                       seq_lens=seq_lens, interpret=interpret,
+                                       paged_attn=paged_attn)
         return out.reshape(B, L, qs), k_cache, v_cache
 
     # -- per-device forwards (inside shard_map) -----------------------------
 
     def dist_fwd(self, params, x_local, k_cache, v_cache, offset, *,
                  seq_lens=None, interpret=None, block_tables=None,
-                 slot_mask=None):
+                 slot_mask=None, paged_attn: str = "fused"):
         """x_local: (B_local, L, d) batch-shard -> same layout out.
         AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203).
         ``seq_lens``: (B,) varlen prefill lengths (nn.attn_with_cache).
-        ``block_tables``/``slot_mask``: paged-KV serving path
-        (``_qkv_to_attn``) — both cover the FULL batch, replicated."""
+        ``block_tables``/``slot_mask``/``paged_attn``: paged-KV serving
+        path (``_qkv_to_attn``) — tables/mask cover the FULL batch,
+        replicated."""
         world = _axis_size(self.axis)
         Bl, L, d = x_local.shape
         qkv = ag_gemm_device(
@@ -213,7 +218,7 @@ class TPAttn:
         out, k_cache, v_cache = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world, seq_lens=seq_lens,
             interpret=interpret, block_tables=block_tables,
-            slot_mask=slot_mask)
+            slot_mask=slot_mask, paged_attn=paged_attn)
         out = gemm_rs_device(
             out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
             config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
@@ -222,7 +227,7 @@ class TPAttn:
 
     def ar_fwd(self, params, x_full, k_cache, v_cache, offset, *,
                interpret=None, seq_lens=None, block_tables=None,
-               slot_mask=None):
+               slot_mask=None, paged_attn: str = "fused"):
         """x_full: (B, L, d) replicated -> replicated out.
         Local GEMMs -> one-shot allreduce (reference dist_triton_AR_fwd)."""
         world = _axis_size(self.axis)
@@ -231,15 +236,19 @@ class TPAttn:
         out, k_cache, v_cache = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world, interpret=interpret,
             seq_lens=seq_lens, block_tables=block_tables,
-            slot_mask=slot_mask)
+            slot_mask=slot_mask, paged_attn=paged_attn)
         partial = out.reshape(B * L, -1) @ params["w_o"]
         out = oneshot_all_reduce(partial, axis=self.axis, interpret=interpret)
         return out.reshape(B, L, d), k_cache, v_cache
 
     def xla_fwd(self, params, x_local, k_cache, v_cache, offset, *,
-                seq_lens=None, block_tables=None, slot_mask=None):
+                seq_lens=None, block_tables=None, slot_mask=None,
+                paged_attn: str = "fused"):
         """Golden/baseline path: same math via jnp + XLA collectives.
-        Batch-sharded in/out like ``dist_fwd``."""
+        Batch-sharded in/out like ``dist_fwd``. ``paged_attn`` still
+        routes paged decode through the fused kernel (interpret mode on
+        CPU), so golden-vs-dist equality covers the block walk too; pass
+        "gather" to pin the dense reference composition."""
         world = _axis_size(self.axis)
         Bl, L, d = x_local.shape
         x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
@@ -248,7 +257,8 @@ class TPAttn:
         out, k_cache, v_cache = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world,
             use_flash_decode=False, seq_lens=seq_lens,
-            block_tables=block_tables, slot_mask=slot_mask)
+            block_tables=block_tables, slot_mask=slot_mask,
+            paged_attn=paged_attn)
         partial = out.reshape(world * Bl * L, -1) @ params["w_o"]
         out = jax.lax.psum_scatter(partial, self.axis, scatter_dimension=0,
                                    tiled=True)

@@ -70,7 +70,7 @@ class Engine:
     # -- compiled step ------------------------------------------------------
 
     def _make_sm(self, mode: str, *, moe_stats: bool = False,
-                 paged: str | None = None):
+                 paged: str | None = None, paged_attn: str = "fused"):
         """The per-mode shard_map of the model forward — the ONE definition
         of the step sharding, shared by the per-step jit (``_step_fn``),
         the scanned loop (``_serve_scanned_fn``), and the drop-stats audit
@@ -81,7 +81,10 @@ class Engine:
         block-paged pool (same spec — kv-heads at index 3 either way) and
         the call takes extra replicated data operands
         (offsets, block_tables, slot_mask[, seq_lens]) so slot churn never
-        changes a shape."""
+        changes a shape. ``paged_attn`` selects the paged decode read path
+        (fused block-walk kernel vs gather fallback — see
+        ``nn.paged_attn_with_cache``); it is baked into the trace, so a
+        BatchEngine picks it once at construction."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
         out_specs = ((P(), kspec, vspec, P()) if moe_stats
@@ -96,7 +99,7 @@ class Engine:
                 return model.forward_device(
                     params, ids, kp, vp, offsets, mode=mode,
                     interpret=self.interpret, block_tables=block_tables,
-                    slot_mask=slot_mask)
+                    slot_mask=slot_mask, paged_attn=paged_attn)
             in_specs = (model.param_specs(), P(), kspec, vspec,
                         P(), P(), P())
         elif paged == "prefill":
@@ -105,7 +108,8 @@ class Engine:
                 return model.forward_device(
                     params, ids, kp, vp, offsets, mode=mode,
                     interpret=self.interpret, block_tables=block_tables,
-                    slot_mask=slot_mask, seq_lens=seq_lens)
+                    slot_mask=slot_mask, seq_lens=seq_lens,
+                    paged_attn=paged_attn)
             in_specs = (model.param_specs(), P(), kspec, vspec,
                         P(), P(), P(), P())
         else:

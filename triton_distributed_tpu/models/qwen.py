@@ -271,7 +271,8 @@ class Qwen3:
     def forward_device(self, params, ids, k_cache, v_cache, offset, *,
                        mode: str = "dist", interpret=None,
                        return_moe_stats: bool = False, seq_lens=None,
-                       block_tables=None, slot_mask=None):
+                       block_tables=None, slot_mask=None,
+                       paged_attn: str = "fused"):
         """One forward step on this device.
 
         ids: (B, L) int32, replicated. k/v_cache: this device's shard
@@ -289,6 +290,9 @@ class Qwen3:
                        switch the caches to the block-paged pool layout
                        (n_layers, n_blocks, block_size, local_kv_heads, dh)
                        — see ``TPAttn._qkv_to_attn``.
+          paged_attn   "fused" (default) routes paged decode through the
+                       fused block-walk kernel; "gather" pins the
+                       materialized-view fallback (nn.paged_attn_with_cache).
 
         ``return_moe_stats=True`` (MoE + mode='dist' only) appends a 4th
         output: ``{"n_dropped_dispatch", "n_dropped_expert"}`` int32 totals
@@ -351,18 +355,21 @@ class Qwen3:
                                           interpret=interpret,
                                           seq_lens=seq_lens,
                                           block_tables=block_tables,
-                                          slot_mask=slot_mask)
+                                          slot_mask=slot_mask,
+                                          paged_attn=paged_attn)
             elif mode == "xla":
                 a, kc, vc = attn.xla_fwd(lp["attn"], hn, kc, vc, offset,
                                          seq_lens=seq_lens,
                                          block_tables=block_tables,
-                                         slot_mask=slot_mask)
+                                         slot_mask=slot_mask,
+                                         paged_attn=paged_attn)
             else:
                 a, kc, vc = attn.ar_fwd(lp["attn"], hn, kc, vc, offset,
                                         interpret=interpret,
                                         seq_lens=seq_lens,
                                         block_tables=block_tables,
-                                        slot_mask=slot_mask)
+                                        slot_mask=slot_mask,
+                                        paged_attn=paged_attn)
             h = resid + a
             resid = h
             hn = nn.rms_norm(h, lp["post_norm"], c.rms_eps)

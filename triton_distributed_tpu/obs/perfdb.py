@@ -224,6 +224,11 @@ def upper_quartile(xs: list[float]) -> float:
 _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
                        "retrace", "_failed", "achieved_over_bound",
                        "queue_wait", "_ms_", "_error")
+# Checked BEFORE the higher-better hints: names the generic hints would
+# misread. "bytes_ratio" (bench --paged-attn: fused/gather HBM traffic)
+# contains "ratio" but fewer bytes win — without the override the gate
+# would wave a traffic regression through as an improvement.
+_LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps")
@@ -238,6 +243,9 @@ def metric_direction(name: str) -> int:
     latency SUFFIXES are endswith-only so ``roofline_sites`` stays
     unknown instead of matching a ``_s`` substring."""
     low = name.lower()
+    for hint in _LOWER_BETTER_OVERRIDES:
+        if hint in low:
+            return -1
     for hint in _HIGHER_BETTER_HINTS:
         if hint in low:
             return 1

@@ -42,6 +42,10 @@ _HBM_TOUCH = {
     "reduce_scatter": 3.0,   # + fp32 accumulate read-modify-write
     "all_reduce": 3.0,
     "ep_all_to_all": 2.0,
+    # Local (world=1) paged decode attention: perf_model.paged_attn_bytes
+    # already counts every HBM touch (pool read once fused / 3x gathered),
+    # so the multiplier is 1 — the recorded bytes ARE the traffic.
+    "paged_attn": 1.0,
 }
 _DEFAULT_TOUCH = 2.0
 
@@ -177,7 +181,7 @@ def summary(records: dict[str, RooflineRecord] | None = None) -> dict:
 # Ordered (first match wins): specific families before generic suffixes.
 _METRIC_CLASS_RULES: tuple[tuple[tuple[str, ...], str], ...] = (
     (("hbm_frac", "flash_decode", "weight_stream", "traffic_floor",
-      "moe_block", "staging_bound"), "hbm"),
+      "moe_block", "staging_bound", "paged_attn"), "hbm"),
     (("a2a", "all_to_all", "ar_loopback", "ar_machinery", "allreduce",
       "ag_staging", "oneshot", "ar_ratio", "dispatch_loopback"), "ici"),
     (("ttft", "tbt", "queue", "serve_", "goodput", "recovery", "e2e",

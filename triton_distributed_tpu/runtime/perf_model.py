@@ -253,6 +253,30 @@ def wire_bytes_all_to_all(per_dev_nbytes: int, world: int) -> int:
     return (world - 1) * per_dev_nbytes // world
 
 
+def paged_attn_bytes(B: int, max_blocks: int, block_size: int,
+                     n_kv_heads: int, head_dim: int, *, n_q_heads: int,
+                     itemsize: int = 2, method: str = "fused") -> int:
+    """HBM bytes one decode-attention step moves reading a block-paged KV
+    pool (per layer, per device shard, worst case: every table full).
+
+    ``fused`` (kernels/paged_attention.py): q read + f32 out write + ONE
+    pass over the K and V pool bytes — the kernel DMAs blocks straight into
+    VMEM, no intermediate view. ``gather`` (sp_attention.paged_gather_kv +
+    dense/flash attention): the same pool bytes are read to build the
+    contiguous (B, max_blocks*block_size, Hkv, dh) view, written into it,
+    and read again by the attention kernel — 3x the KV bill. The comm
+    ledger records this next to the achieved wall time, so the fused-vs-
+    gather ratio in bench.py's ``paged_attn`` arm is this exact arithmetic.
+    """
+    kv = 2 * B * max_blocks * block_size * n_kv_heads * head_dim * itemsize
+    q_out = B * n_q_heads * head_dim * (itemsize + 4)   # wire-dtype q, f32 out
+    if method == "fused":
+        return q_out + kv
+    if method == "gather":
+        return q_out + 3 * kv
+    raise ValueError(f"method must be 'fused' or 'gather', got {method!r}")
+
+
 def est_matmul(m: int, k: int, n: int, itemsize: int = 2,
                hw: Hardware | None = None, mfu: float = 0.85) -> float:
     """Roofline matmul time: max(MXU at ``mfu``, HBM traffic). The SOL

@@ -99,6 +99,12 @@ class BatchEngine:
                    ALWAYS compiled into the steps (SPMD safety — see
                    module docstring); this flag only enables the host-side
                    check of it.
+    ``paged_attn`` "fused" (default): decode attention walks the block
+                   table inside the Pallas kernel — one pass over the pool
+                   bytes. "gather": the materialized-view reference path
+                   (``paged_gather_kv``), the escape hatch the fused kernel
+                   is verified token-identical against. Baked into the
+                   compiled steps at construction.
     """
 
     def __init__(self, engine: Engine, *, n_slots: int = 8,
@@ -106,7 +112,11 @@ class BatchEngine:
                  prefill_chunk: int = 32, max_seq_len: int | None = None,
                  seed: int = 0, admission_pressure: float = 0.0,
                  retry: _guards.RetryPolicy | None = None,
-                 nan_guard: bool = False):
+                 nan_guard: bool = False, paged_attn: str = "fused"):
+        if paged_attn not in ("fused", "gather"):
+            raise ValueError(
+                f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
+        self.paged_attn = paged_attn
         self.engine = engine
         world = engine.mesh.shape[engine.model.axis]
         if engine.decode_mode in ("dist", "xla") and n_slots % world:
@@ -147,8 +157,10 @@ class BatchEngine:
     def _build_steps(self):
         eng = self.engine
         V = eng.config.vocab_size
-        sm_dec = eng._make_sm(eng.decode_mode, paged="decode")
-        sm_pre = eng._make_sm(eng.prefill_mode, paged="prefill")
+        sm_dec = eng._make_sm(eng.decode_mode, paged="decode",
+                              paged_attn=self.paged_attn)
+        sm_pre = eng._make_sm(eng.prefill_mode, paged="prefill",
+                              paged_attn=self.paged_attn)
         temperature, top_p = eng.temperature, eng.top_p
         trace_counts = self.trace_counts
 
@@ -263,6 +275,12 @@ class BatchEngine:
                 out[k] = float(m[k])
         out["retraces"] = max(0.0, float(self.trace_counts["decode"]
                                          + self.trace_counts["prefill"] - 2))
+        # Pool fragmentation (KVPool.fragmentation): lets block-size sweeps
+        # in the run DB separate allocator shredding from kernel effects.
+        frag = self.pool.fragmentation()
+        out["pool_free_blocks"] = float(frag["free_blocks"])
+        out["pool_largest_free_run"] = float(frag["largest_free_run"])
+        out["pool_frag_frac"] = float(frag["frag_frac"])
         return out
 
     def _call_step(self, site: str, fn):

@@ -146,6 +146,28 @@ class KVPool:
         for b in reversed(table):
             self._free.append(b)
 
+    def fragmentation(self) -> dict:
+        """Free-list fragmentation stats for the perf flight recorder:
+        ``free_blocks`` (allocatable headroom), ``largest_free_run``
+        (longest run of CONSECUTIVE free block ids — the best a streaming
+        reader can hope to touch sequentially), and ``frag_frac``
+        (1 - largest_run/free, 0.0 = one contiguous extent, -> 1.0 = free
+        space shredded across the pool). Allocation itself never needs
+        contiguity (any free block serves), so this is an observability
+        stat, not an allocator constraint: block-size sweeps in the run DB
+        (``BatchEngine.perfdb_sample``) use it to tell whether a latency
+        shift came from pool shredding or from the kernel."""
+        free = sorted(self._free)
+        longest = run = 0
+        prev = None
+        for b in free:
+            run = run + 1 if prev is not None and b == prev + 1 else 1
+            longest = max(longest, run)
+            prev = b
+        frag = 0.0 if not free else 1.0 - longest / len(free)
+        return {"free_blocks": len(free), "largest_free_run": longest,
+                "frag_frac": round(frag, 4)}
+
     def table(self, seq_id) -> list[int]:
         return list(self._tables.get(seq_id, ()))
 
