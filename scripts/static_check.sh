@@ -1,0 +1,53 @@
+#!/usr/bin/env bash
+# Static checks for the distributed kernel layer — no TPU, runs anywhere.
+#
+#   1. tools/comm_check.py          -> trace every registered kernel at
+#                                      world 2/4/8 through the comm-safety
+#                                      analyzer (semaphore balance, DMA
+#                                      completion, happens-before races,
+#                                      deadlock-freedom) + the AST pass
+#                                      (discarded DMA handles, Python-int
+#                                      rank escapes). docs/analysis.md.
+#   2. tools/check_no_bare_print.py -> no bare print() in package or tools
+#                                      code (dist_print only).
+#
+# Usage: bash scripts/static_check.sh [--tier1]
+#   --tier1  additionally run the tier-1 pytest suite after the static
+#            checks (the same tests CI runs; slower).
+#
+# Exit: nonzero if any check fails.
+
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+rc=0
+
+echo "== comm-safety analyzer (tools/comm_check.py) =="
+python -m tools.comm_check --world 2 --world 4 --world 8 || rc=1
+
+echo
+echo "== bare-print lint (tools/check_no_bare_print.py) =="
+if python tools/check_no_bare_print.py; then
+    echo "no bare prints."
+else
+    rc=1
+fi
+
+if [[ "${1:-}" == "--tier1" ]]; then
+    echo
+    echo "== tier-1 pytest =="
+    python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider || rc=1
+fi
+
+if [[ $rc -ne 0 ]]; then
+    echo
+    echo "static_check: FAILED" >&2
+else
+    echo
+    echo "static_check: all checks clean."
+fi
+exit $rc

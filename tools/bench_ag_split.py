@@ -14,6 +14,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from triton_distributed_tpu.runtime.utils import dist_print  # noqa: E402
+
 M, K, N = 4096, 5120, 3200
 FLOPS = 2 * M * K * N
 SHORT, LONG = 32, 96
@@ -84,12 +86,13 @@ def main():
     for name, s in zip(names, samples):
         s = sorted(s)
         lq = s[max(0, (len(s) - 1) // 4)] if s else float("nan")
-        print(f"{name}: lq={lq:.4f} ms  samples={['%.3f' % x for x in s]}")
+        dist_print(f"{name}: lq={lq:.4f} ms  "
+                   f"samples={['%.3f' % x for x in s]}")
     if samples[0] and samples[2]:
         lqs = [sorted(s)[max(0, (len(s) - 1) // 4)] for s in samples]
-        print(f"overlap_efficiency = {lqs[2] / lqs[0]:.4f}")
-        print(f"grid_structure_ms = {lqs[1] - lqs[2]:.4f}")
-        print(f"staging_machinery_ms = {lqs[0] - lqs[1]:.4f}")
+        dist_print(f"overlap_efficiency = {lqs[2] / lqs[0]:.4f}")
+        dist_print(f"grid_structure_ms = {lqs[1] - lqs[2]:.4f}")
+        dist_print(f"staging_machinery_ms = {lqs[0] - lqs[1]:.4f}")
 
 
 if __name__ == "__main__":

@@ -7,8 +7,7 @@ every host into one stream — undebuggable. Library code must route through
 is the single allowed home of the underlying ``print`` call. ``tools/``
 CLIs are in scope too (they run on pods via scripts/launch.sh): structured
 output goes through ``dist_print`` or raw ``sys.stdout.write`` JSON/
-markdown — the three legacy sweep/profile scripts are grandfathered in the
-allow list and take no new members.
+markdown — no exceptions.
 
 AST-based (not grep): ``print`` inside strings, comments, or docstrings is
 fine; only a real ``Name('print')`` call node is flagged. ``print``
@@ -28,15 +27,6 @@ import sys
 # Files (scan-root-relative, posix-style) allowed to call print directly.
 ALLOWED = {
     "runtime/utils.py",       # dist_print's own implementation
-}
-
-# Legacy tools/ scripts grandfathered before tools/ entered the lint scope
-# (single-host bench harnesses predating the pod story). New tools must be
-# clean — do not add entries.
-TOOLS_ALLOWED = {
-    "bench_ag_split.py",
-    "profile_decode.py",
-    "sweep_matmul.py",
 }
 
 PKG = "triton_distributed_tpu"
@@ -74,7 +64,7 @@ def find_bare_prints(root: str) -> list[tuple[str, int]]:
     violations = _scan_tree(os.path.join(root, PKG), ALLOWED)
     tools_dir = os.path.join(root, TOOLS_DIR)
     if os.path.isdir(tools_dir):
-        violations += _scan_tree(tools_dir, TOOLS_ALLOWED)
+        violations += _scan_tree(tools_dir, set())
     return violations
 
 

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Comm-safety gate: statically check the distributed kernels' choreography.
+
+Traces every registered kernel (``analysis/registry.py`` — the ``@register``
+blocks at the bottom of each ``kernels/*.py``) through the instrumented
+SPMD interpreter (``analysis/events.py``) at each requested world size,
+replays the per-rank logs against each other (``analysis/comm_graph.py``),
+and asserts the four hazard classes (``analysis/checks.py``):
+
+    semaphore balance, DMA completion, happens-before on buffers,
+    and global deadlock-freedom.
+
+An AST companion pass (``analysis/ast_checks.py``) additionally scans the
+kernel + language sources for Python-visible hazards: discarded DMA handles
+that are provably never waited, and rank values escaping into Python
+control flow. Everything runs on CPU in seconds — no TPU needed.
+
+Prints a markdown report (stdout, optionally ``--report`` file) and exits
+
+    0   every check clean
+    1   at least one violation (trace-based or AST)
+    2   usage error (unknown kernel, no world sizes, bad arguments)
+
+CI invocation (the exact line ``scripts/static_check.sh`` runs):
+
+    python -m tools.comm_check --world 2 --world 4 --world 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before any jax import
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/comm_check.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.analysis import ast_checks, checks, registry  # noqa: E402
+
+
+def _out(line: str = "") -> None:
+    sys.stdout.write(line + "\n")
+
+
+def _err(line: str) -> None:
+    sys.stderr.write(line + "\n")
+
+
+def run_sweep(names: list[str], worlds: list[int]):
+    """[(kernel, world, [Violation])] — one row per (kernel, world) pair
+    actually checked (a kernel registered for fewer worlds skips the rest)."""
+    rows = []
+    for name in names:
+        entry = registry.get(name)
+        for w in worlds:
+            if w not in entry.worlds:
+                continue
+            rows.append((name, w, checks.check_kernel(name, w)))
+    return rows
+
+
+def render_report(rows, ast_findings, worlds) -> str:
+    n_viol = sum(len(vs) for _, _, vs in rows) + len(ast_findings)
+    lines = [
+        "# Comm-safety report",
+        "",
+        f"worlds: {', '.join(map(str, worlds))} — "
+        f"{len(rows)} kernel/world trace(s), "
+        f"{len(ast_findings)} AST finding(s), "
+        f"**{n_viol} violation(s)** total",
+        "",
+        "| kernel | world | deadlock | sem-balance | dma-completion |"
+        " buffer-race | verdict |",
+        "|---|---:|---:|---:|---:|---:|---|",
+    ]
+    for name, w, vs in rows:
+        by = {c: sum(1 for v in vs if v.check == c) for c in checks.CHECKS}
+        trace_err = by.pop("trace-error", 0)
+        verdict = ("**TRACE ERROR**" if trace_err
+                   else "**VIOLATION**" if vs else "clean")
+        lines.append(
+            f"| `{name}` | {w} | {by['deadlock']} | {by['sem-balance']} |"
+            f" {by['dma-completion']} | {by['buffer-race']} | {verdict} |")
+    lines.append("")
+    detail = [str(v) for _, _, vs in rows for v in vs]
+    if detail:
+        lines += ["## Trace violations", ""]
+        lines += [f"- {d}" for d in detail]
+        lines.append("")
+    if ast_findings:
+        lines += ["## AST findings", ""]
+        lines += [f"- {f}" for f in ast_findings]
+        lines.append("")
+    if n_viol:
+        lines.append(f"**{n_viol} violation(s)** — see details above.")
+    else:
+        lines.append("all comm-safety checks clean.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--world", type=int, action="append", default=None,
+                    help="world size to check (repeatable; default 2 4 8)")
+    ap.add_argument("--kernel", action="append", default=None,
+                    help="check only this registered kernel (repeatable; "
+                         "hidden mutant.* entries must be named explicitly)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered kernels and exit")
+    ap.add_argument("--no-ast", action="store_true",
+                    help="skip the AST companion pass")
+    ap.add_argument("--ast-root", default=_REPO_ROOT,
+                    help="repo root for the AST pass (default: this repo)")
+    ap.add_argument("--report", default=None,
+                    help="also write the markdown report to this path")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for e in registry.all_kernels(include_hidden=True):
+            tag = "  [hidden]" if e.hidden else ""
+            _out(f"{e.name}  worlds={list(e.worlds)}  ({e.module}){tag}")
+        return 0
+
+    worlds = args.world or [2, 4, 8]
+    if any(w < 1 for w in worlds):
+        _err("comm_check: world sizes must be >= 1")
+        return 2
+
+    if args.kernel:
+        try:
+            names = [registry.get(n).name for n in args.kernel]
+        except KeyError as e:
+            _err(f"comm_check: {e.args[0]}")
+            return 2
+    else:
+        names = [e.name for e in registry.all_kernels()]
+    if not names:
+        _err("comm_check: no kernels registered")
+        return 2
+
+    rows = run_sweep(names, worlds)
+    ast_findings = ([] if args.no_ast
+                    else ast_checks.check_tree(args.ast_root))
+
+    report = render_report(rows, ast_findings, worlds)
+    _out(report)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(report)
+    n_viol = sum(len(vs) for _, _, vs in rows) + len(ast_findings)
+    return 1 if n_viol else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

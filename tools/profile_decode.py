@@ -25,12 +25,14 @@ def slope(loop, args, n=5):
 
 from triton_distributed_tpu.models import ModelConfig
 from triton_distributed_tpu.kernels.sp_attention import flash_decode_local
+from triton_distributed_tpu.runtime.utils import dist_print
 
 c = ModelConfig.from_name("qwen3-1.7b", max_length=512)
 B, S, L = 8, 512, 28
 d, Hq, Hkv, dh, dff, V = (c.d_model, c.n_heads, c.n_kv_heads, c.head_dim,
                           c.d_ff, c.vocab_size)
-print(f"config: d={d} Hq={Hq} Hkv={Hkv} dh={dh} dff={dff} V={V} layers={c.n_layers}")
+dist_print(f"config: d={d} Hq={Hq} Hkv={Hkv} dh={dh} dff={dff} V={V} "
+           f"layers={c.n_layers}")
 key = jax.random.PRNGKey(0)
 
 # stacked per-layer weights (as the scan sees them)
@@ -109,9 +111,10 @@ floors = {
   "mlp": (wgu.nbytes + wdn.nbytes) / hbm * 1e3,
   "lm_head": lm.nbytes / hbm * 1e3,
 }
-print(f"attn_proj: {t_proj:.3f} ms (floor {floors['attn_proj']:.3f})")
-print(f"flash_attn: {t_attn:.3f} ms (floor {floors['flash_attn']:.3f})")
-print(f"mlp: {t_mlp:.3f} ms (floor {floors['mlp']:.3f})")
-print(f"lm_head: {t_lm:.3f} ms (floor {floors['lm_head']:.3f})")
-print(f"cache_upd: {t_cache:.3f} ms")
-print(f"sum: {t_proj + t_attn + t_mlp + t_lm + t_cache:.3f} ms  (e2e measured ~7.4-8.0)")
+dist_print(f"attn_proj: {t_proj:.3f} ms (floor {floors['attn_proj']:.3f})")
+dist_print(f"flash_attn: {t_attn:.3f} ms (floor {floors['flash_attn']:.3f})")
+dist_print(f"mlp: {t_mlp:.3f} ms (floor {floors['mlp']:.3f})")
+dist_print(f"lm_head: {t_lm:.3f} ms (floor {floors['lm_head']:.3f})")
+dist_print(f"cache_upd: {t_cache:.3f} ms")
+dist_print(f"sum: {t_proj + t_attn + t_mlp + t_lm + t_cache:.3f} ms  "
+           "(e2e measured ~7.4-8.0)")

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 
 sys.path.insert(0, ".")
 from triton_distributed_tpu.kernels.allgather_gemm import ag_gemm_single_chip  # noqa: E402
+from triton_distributed_tpu.runtime.utils import dist_print  # noqa: E402
 
 if len(sys.argv) == 1:
     M, K, N = 4096, 5120, 3200
@@ -74,8 +75,8 @@ def main():
     flops = 2 * M * K * N
 
     def report(name, ms):
-        print(f"{name:32s}: {ms:7.3f} ms  {flops / ms / 1e9:6.1f} TFLOPs",
-              flush=True)
+        dist_print(f"{name:32s}: {ms:7.3f} ms  {flops / ms / 1e9:6.1f} "
+                   "TFLOPs", flush=True)
 
     xla = make_loop(lambda a, b: jnp.dot(
         a, b, preferred_element_type=jnp.float32).astype(jnp.bfloat16))
@@ -98,10 +99,10 @@ def main():
             results.append((ms, bm, bn, bk))
             report(f"pallas bm={bm} bn={bn} bk={bk}", ms)
         except Exception as e:
-            print(f"pallas bm={bm} bn={bn} bk={bk}: FAIL {type(e).__name__}",
-                  flush=True)
+            dist_print(f"pallas bm={bm} bn={bn} bk={bk}: FAIL "
+                       f"{type(e).__name__}", flush=True)
     results.sort()
-    print("\nbest:", results[:3])
+    dist_print("\nbest:", results[:3])
     report("xla recheck", slope_ms(xla, a, b, flops))
 
 

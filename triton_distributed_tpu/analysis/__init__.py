@@ -1,0 +1,9 @@
+"""Static comm-safety analyzer for the distributed Pallas kernels.
+
+Verifies semaphore balance, DMA completion, buffer happens-before, and
+cross-rank deadlock-freedom by instrumented SPMD abstract interpretation —
+no TPU required. See docs/analysis.md and ``tools/comm_check.py``.
+"""
+
+from triton_distributed_tpu.analysis import registry  # noqa: F401
+from triton_distributed_tpu.analysis.registry import register  # noqa: F401

@@ -1,0 +1,173 @@
+"""AST-level companion pass: Python-visible comm hazards.
+
+Two rules, both heuristic by design (the trace-based checks in
+:mod:`analysis.checks` are the precise ones; this pass catches the
+mistakes that are visible *before* any trace runs):
+
+1. **Discarded DMA handle** — a DMA-creating call (``remote_copy``,
+   ``putmem_nbi``, ``putmem_signal_nbi``, ``make_async_remote_copy``)
+   used as a bare expression statement (handle thrown away) inside a
+   top-level function whose body contains **no** wait token at all
+   (``.wait() / .wait_send() / .wait_recv() / quiet / wait_send /
+   wait_recv / wait_dma_arrival / wait_send_bytes``).  Kernels that stash
+   handles or drain via re-derived ``wait_send(ref, sem)`` calls stay
+   clean; a function that fires a put and provably never waits anything
+   is flagged.
+
+2. **Python-int rank arithmetic** — a ``range(...)``, ``int(...)`` or
+   ``if``-test whose subtree calls ``axis_index`` / ``my_pe``: evaluating
+   the rank at Python trace time bakes *this* rank's value into the traced
+   program, which is wrong for every other rank.  Rank-dependent control
+   flow belongs in ``pl.when`` / ``jax.lax`` ops.
+
+Analysis granularity is the **top-level function** (module functions and
+class methods), over its full subtree including nested helpers — the
+kernels' ``@pl.when``-decorated closures pair starts and waits across
+sibling nested functions, so anything finer would false-positive.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+
+DMA_CREATING = {
+    "remote_copy",
+    "putmem_nbi",
+    "putmem_signal_nbi",
+    "make_async_remote_copy",
+}
+
+WAIT_TOKENS = {
+    "wait",
+    "wait_send",
+    "wait_recv",
+    "quiet",
+    "wait_dma_arrival",
+    "wait_send_bytes",
+}
+
+RANK_CALLS = {"axis_index", "my_pe"}
+
+ESCAPING_PYTHON = ("range", "int")
+
+
+@dataclasses.dataclass(frozen=True)
+class AstFinding:
+    path: str
+    line: int
+    rule: str       # 'discarded-dma' | 'python-rank'
+    detail: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.detail}"
+
+
+def _call_name(call: ast.Call) -> str | None:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def _calls_in(node: ast.AST):
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = _call_name(sub)
+            if name is not None:
+                yield name, sub
+
+
+def _top_level_functions(tree: ast.Module):
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield sub
+
+
+def check_source(src: str, path: str = "<string>") -> list[AstFinding]:
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [AstFinding(path, e.lineno or 0, "parse-error", str(e))]
+    findings: list[AstFinding] = []
+    for fn in _top_level_functions(tree):
+        findings.extend(_check_discarded_dma(fn, path))
+    findings.extend(_check_python_rank(tree, path))
+    return findings
+
+
+def _check_discarded_dma(fn: ast.AST, path: str) -> list[AstFinding]:
+    has_wait = any(name in WAIT_TOKENS for name, _ in _calls_in(fn))
+    if has_wait:
+        return []
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Expr):
+            continue
+        dma_calls = [c for name, c in _calls_in(node.value)
+                     if name in DMA_CREATING]
+        for call in dma_calls:
+            out.append(AstFinding(
+                path, call.lineno, "discarded-dma",
+                f"{_call_name(call)}(...) handle is discarded and "
+                f"{getattr(fn, 'name', '<fn>')} contains no wait/quiet — "
+                "the DMA is never completed"))
+    return out
+
+
+def _check_python_rank(tree: ast.AST, path: str) -> list[AstFinding]:
+    out = []
+    for node in ast.walk(tree):
+        rank_call = None
+        site = None
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in ESCAPING_PYTHON:
+                site = f"{name}(...)"
+                rank_call = _find_rank_call(node)
+        elif isinstance(node, ast.If):
+            site = "Python `if` test"
+            rank_call = _find_rank_call(node.test)
+        if rank_call is not None:
+            out.append(AstFinding(
+                path, rank_call.lineno, "python-rank",
+                f"{_call_name(rank_call)}() inside {site} escapes the "
+                "traced program into Python — this bakes one rank's value "
+                "into the trace; use pl.when / jax.lax control flow"))
+    return out
+
+
+def _find_rank_call(node: ast.AST) -> ast.Call | None:
+    for name, call in _calls_in(node):
+        if name in RANK_CALLS:
+            return call
+    return None
+
+
+def check_file(path: str) -> list[AstFinding]:
+    with open(path, encoding="utf-8") as f:
+        return check_source(f.read(), path)
+
+
+def check_tree(root: str, subdirs=("triton_distributed_tpu/kernels",
+                                   "triton_distributed_tpu/language")
+               ) -> list[AstFinding]:
+    """Run the pass over the kernel + language layers of a repo tree."""
+    findings: list[AstFinding] = []
+    for sub in subdirs:
+        d = os.path.join(root, sub)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, _dirs, files in os.walk(d):
+            for fname in sorted(files):
+                if fname.endswith(".py"):
+                    findings.extend(
+                        check_file(os.path.join(dirpath, fname)))
+    return findings

@@ -1,0 +1,144 @@
+"""Cross-rank signal→wait graph assembly over per-rank event logs.
+
+Given the N per-rank logs from :mod:`analysis.events`, this module replays
+them against each other with a **greedy run-to-completion simulation**:
+keep advancing any rank whose next event is enabled (increments always
+are; a wait is enabled once the semaphore's accumulated count on that rank
+covers the wait amount, and then consumes it).  The semaphore system is
+monotone — executing an enabled event never disables another — so the
+greedy schedule is complete: if it wedges with every rank blocked, *every*
+schedule wedges, and the blocked waits are a true deadlock.
+
+While replaying we attribute consumption FIFO per ``(rank, semaphore)``:
+each increment joins a queue and waits drain from the front (partial
+drains allowed — one big wait may retire many small DMA increments, e.g. a
+full-row arrival wait covering per-tile pushes).  The attribution is what
+turns the flat logs into the signal→wait edges that the DMA-completion and
+happens-before checks in :mod:`analysis.checks` consume.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import defaultdict, deque
+
+from triton_distributed_tpu.analysis.events import Event, _fmt_sem
+
+
+@dataclasses.dataclass
+class BlockedWait:
+    rank: int
+    event: Event
+    needed: int
+    available: int
+    # Ranks holding future (not yet executed) increments that target this
+    # wait's (rank, semaphore); empty => no possible signal exists.
+    feeders: tuple[int, ...]
+
+
+@dataclasses.dataclass
+class SimResult:
+    completed: bool
+    blocked: list        # list[BlockedWait], nonempty iff not completed
+    cycles: list         # list[list[int]] rank cycles in the wait-for graph
+    leftover: dict       # (rank, sem) -> count left at exit (completed only)
+    consumption: dict    # inc eid -> list[(wait Event, amount)]
+    inc_remaining: dict  # inc eid -> unconsumed amount
+    edges: list          # (inc Event, wait Event, amount) signal→wait graph
+
+
+def simulate(logs: list) -> SimResult:
+    n = len(logs)
+    counts: dict = defaultdict(int)          # (rank, sem) -> available
+    queues: dict = defaultdict(deque)        # (rank, sem) -> [eid, remaining]
+    inc_events: dict = {}
+    consumption: dict = defaultdict(list)
+    inc_remaining: dict = {}
+    ptr = [0] * n
+
+    progress = True
+    while progress:
+        progress = False
+        for r in range(n):
+            while ptr[r] < len(logs[r]):
+                ev = logs[r][ptr[r]]
+                if ev.kind == "inc":
+                    key = (ev.target, ev.sem)
+                    counts[key] += ev.amount
+                    queues[key].append([ev.eid, ev.amount])
+                    inc_events[ev.eid] = ev
+                    inc_remaining[ev.eid] = ev.amount
+                elif ev.kind == "wait":
+                    key = (r, ev.sem)
+                    if counts[key] < ev.amount:
+                        break  # blocked; try other ranks
+                    counts[key] -= ev.amount
+                    need = ev.amount
+                    q = queues[key]
+                    while need > 0 and q:
+                        head = q[0]
+                        take = min(head[1], need)
+                        head[1] -= take
+                        need -= take
+                        consumption[head[0]].append((ev, take))
+                        inc_remaining[head[0]] -= take
+                        if head[1] == 0:
+                            q.popleft()
+                ptr[r] += 1
+                progress = True
+
+    completed = all(ptr[r] == len(logs[r]) for r in range(n))
+    blocked: list[BlockedWait] = []
+    cycles: list[list[int]] = []
+    if not completed:
+        waits_on: dict[int, tuple[int, ...]] = {}
+        for r in range(n):
+            if ptr[r] >= len(logs[r]):
+                continue
+            ev = logs[r][ptr[r]]
+            # The stuck event is always a wait (incs are always enabled).
+            feeders = tuple(sorted({
+                r2 for r2 in range(n)
+                for fut in logs[r2][ptr[r2]:]
+                if fut.kind == "inc" and fut.target == r
+                and fut.sem == ev.sem}))
+            blocked.append(BlockedWait(
+                rank=r, event=ev, needed=ev.amount,
+                available=counts[(r, ev.sem)], feeders=feeders))
+            waits_on[r] = feeders
+        cycles = _find_cycles(waits_on)
+
+    leftover = {k: v for k, v in counts.items() if v} if completed else {}
+    edges = [(inc_events[eid], w, amt)
+             for eid, pairs in consumption.items() for (w, amt) in pairs]
+    return SimResult(completed=completed, blocked=blocked, cycles=cycles,
+                     leftover=leftover, consumption=dict(consumption),
+                     inc_remaining=inc_remaining, edges=edges)
+
+
+def _find_cycles(waits_on: dict[int, tuple[int, ...]]) -> list[list[int]]:
+    """Simple cycles among blocked ranks in the wait-for relation (rank r
+    waits-for rank r' if r' still holds a future increment r needs)."""
+    cycles: list[list[int]] = []
+    seen_cycles: set[tuple[int, ...]] = set()
+    for start in waits_on:
+        stack = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in waits_on.get(node, ()):
+                if nxt == start and len(path) > 0:
+                    canon = tuple(sorted(path))
+                    if canon not in seen_cycles:
+                        seen_cycles.add(canon)
+                        cycles.append(path[:])
+                elif nxt in waits_on and nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+    return cycles
+
+
+def describe_blocked(b: BlockedWait) -> str:
+    sem = _fmt_sem(b.event.sem)
+    why = ("no possible signal exists" if not b.feeders else
+           f"pending signals held by rank(s) {list(b.feeders)}")
+    return (f"rank {b.rank} stuck at event {b.event.seq} waiting "
+            f"{b.needed} on semaphore {sem} (has {b.available}; {why})")

@@ -1,0 +1,148 @@
+"""Seeded-mutant kernels: known-broken comm choreography the analyzer must
+flag.  Each is a copy of a real kernel body with one classic SPMD bug
+injected; they register as hidden ``mutant.*`` entries (excluded from the
+default ``tools/comm_check.py`` sweep, runnable via ``--kernel``) and the
+regression tests in ``tests/test_comm_check.py`` assert a nonzero exit on
+every one of them.
+
+Mutants:
+* ``mutant.ag_ring_drop_wait_send`` — ring allgather without the final
+  send-drain loop (``allgather.py``'s ``for dma in sends: dma.wait_send()``
+  deleted): undrained send semaphores + unawaited DMAs.
+* ``mutant.barrier_double_notify`` — a barrier that signals every peer
+  **twice** but still waits ``world - 1``: each rank exits with ``world-1``
+  stale signals on the shared barrier semaphore, corrupting the next
+  collective that uses it.
+* ``mutant.ll_ag_recv_slot_off_by_one`` — low-latency allgather whose
+  consumer waits the recv semaphore at source slot ``(src + 1) % world``
+  instead of ``src``: the wait can never be fed (deadlock) and the staging
+  read races the actual arrival.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from triton_distributed_tpu.analysis import registry
+from triton_distributed_tpu.analysis.registry import Buf, Sem, TraceSpec
+from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.language import primitives as dl
+from triton_distributed_tpu.runtime.compat import axis_size as _axis_size
+from triton_distributed_tpu.runtime.compat import mesh_device_id as _mesh_device_id
+
+
+_M, _REST = 8, (128,)
+
+
+def _ring_ag_kernel_drop_wait_send(x_ref, o_ref, send_sems, recv_sems,
+                                   copy_sem, *, axis: str, world: int):
+    # == kernels/allgather.py:_ring_ag_kernel with the send drain DELETED.
+    me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0]
+    right = jax.lax.rem(me + 1, world)
+    dl.barrier_all(axis)
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+    for s in range(world - 1):
+        src = jax.lax.rem(me - s + world, world)
+        common.remote_copy(
+            o_ref.at[pl.ds(src * m, m)], o_ref.at[pl.ds(src * m, m)],
+            send_sems.at[s], recv_sems.at[s], axis, right)
+        rsrc = jax.lax.rem(me - 1 - s + world, world)
+        common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], recv_sems.at[s])
+    # BUG: `for dma in sends: dma.wait_send()` is missing.
+
+
+def _barrier_double_notify_kernel(o_ref, copy_sem, *, axis: str, world: int):
+    # == language/primitives.py:barrier_all signalling every peer TWICE.
+    del o_ref, copy_sem
+    w = _axis_size(axis)
+    me = jax.lax.axis_index(axis)
+    barrier_sem = pltpu.get_barrier_semaphore()
+
+    def signal_peer(i, _):
+        peer = jax.lax.rem(me + 1 + i, w)
+        for _twice in range(2):  # BUG: double notify
+            pltpu.semaphore_signal(
+                barrier_sem, inc=1,
+                device_id=_mesh_device_id(axis, peer),
+                device_id_type=pltpu.DeviceIdType.MESH)
+        return _
+
+    jax.lax.fori_loop(0, w - 1, signal_peer, None)
+    pltpu.semaphore_wait(barrier_sem, w - 1)
+
+
+def _ll_ag_kernel_recv_slot_off_by_one(p_ref, x_ref, staging_ref, o_ref,
+                                       staging_out, send_sems, recv_sems,
+                                       copy_sem, *, axis: str, world: int):
+    # == kernels/ll_allgather.py:_ll_ag_kernel with the consumer waiting the
+    # wrong recv-semaphore source slot.
+    del staging_out
+    me = jax.lax.axis_index(axis)
+    m = x_ref.shape[0]
+    p = p_ref[0]
+    sends = []
+    for i in range(world - 1):
+        peer = jax.lax.rem(me + 1 + i, world)
+        dma = common.remote_copy(
+            x_ref, staging_ref.at[p, common.peer_slot(me, peer)],
+            send_sems.at[i], recv_sems.at[p, me], axis, peer)
+        sends.append(dma)
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+    for src in range(world):
+        @pl.when(src != me)
+        def _consume(src=src):
+            slot = common.peer_slot(src, me)
+            wrong = jax.lax.rem(src + 1, world)  # BUG: off-by-one source
+            common.wait_recv(staging_ref.at[p, slot],
+                             recv_sems.at[p, wrong])
+            common.local_copy(staging_ref.at[p, slot],
+                              o_ref.at[pl.ds(src * m, m)], copy_sem)
+    for dma in sends:
+        dma.wait_send()
+
+
+@registry.register("mutant.ag_ring_drop_wait_send", hidden=True)
+def _build_ring_mutant(world: int) -> TraceSpec:
+    return TraceSpec(
+        body=_ring_ag_kernel_drop_wait_send,
+        args=[
+            Buf("x", (_M, *_REST)),
+            Buf("o", (world * _M, *_REST)),
+            Sem("send_sems", (world - 1,)),
+            Sem("recv_sems", (world,)),
+            Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+@registry.register("mutant.barrier_double_notify", hidden=True)
+def _build_barrier_mutant(world: int) -> TraceSpec:
+    return TraceSpec(
+        body=_barrier_double_notify_kernel,
+        args=[Buf("o", (_M, *_REST)), Sem("copy_sem")],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+@registry.register("mutant.ll_ag_recv_slot_off_by_one", hidden=True)
+def _build_ll_mutant(world: int) -> TraceSpec:
+    return TraceSpec(
+        body=_ll_ag_kernel_recv_slot_off_by_one,
+        args=[
+            Buf("p", (1,), np.int32),
+            Buf("x", (_M, *_REST)),
+            Buf("staging", (2, world - 1, _M, *_REST)),
+            Buf("o", (world * _M, *_REST)),
+            Buf("staging_out", (1,)),
+            Sem("send_sems", (world - 1,)),
+            Sem("recv_sems", (2, world)),
+            Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )

@@ -1,0 +1,128 @@
+"""Kernel registry for the comm-safety analyzer.
+
+Each distributed kernel module registers one ``build(world) -> TraceSpec``
+per entry point (at the bottom of the file, so registration rides along
+with the kernel definition). A ``TraceSpec`` names the kernel body, its
+grid, and a declarative argument list (``Buf``/``Sem``) with representative
+shapes small enough to trace on CPU in milliseconds.
+
+This module is deliberately light: it imports nothing heavy at module
+level so ``tools/comm_check.py`` can enumerate kernels lazily. Kernel
+modules import *us*; we import *them* only inside :func:`all_kernels`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Buf:
+    """A buffer argument (input, output, or scratch — the analyzer does not
+    care which): one private instance is allocated per rank.
+
+    ``init(rank, world)`` returns the initial ndarray; default zeros.
+    """
+
+    name: str
+    shape: tuple[int, ...]
+    dtype: Any = np.float32
+    init: Callable[[int, int], np.ndarray] | None = None
+
+    def make(self, rank: int, world: int) -> np.ndarray:
+        if self.init is not None:
+            arr = np.asarray(self.init(rank, world), dtype=self.dtype)
+            if arr.shape != tuple(self.shape):
+                raise ValueError(
+                    f"Buf {self.name!r}: init produced shape {arr.shape}, "
+                    f"declared {self.shape}")
+            return np.ascontiguousarray(arr)
+        return np.zeros(self.shape, dtype=self.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sem:
+    """A semaphore (array) argument. ``shape=()`` is a single semaphore."""
+
+    name: str
+    shape: tuple[int, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceSpec:
+    """Everything needed to trace one kernel entry point at one world size."""
+
+    body: Callable[..., Any]
+    args: Sequence[Buf | Sem]
+    grid: tuple[int, ...] = ()
+    kwargs: dict[str, Any] = dataclasses.field(default_factory=dict)
+    # Number of ranks to actually trace. None -> world. Loopback (single
+    # chip) kernels simulate `world` slots on one rank and set ranks=1.
+    ranks: int | None = None
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelEntry:
+    name: str
+    build: Callable[[int], TraceSpec]
+    worlds: tuple[int, ...]
+    module: str
+    hidden: bool  # hidden entries (seeded mutants) are excluded from sweeps
+
+
+_REGISTRY: dict[str, KernelEntry] = {}
+
+# Modules that carry @register blocks; imported lazily by all_kernels()/get().
+_KERNEL_MODULES = (
+    "triton_distributed_tpu.kernels.allgather",
+    "triton_distributed_tpu.kernels.ll_allgather",
+    "triton_distributed_tpu.kernels.allreduce",
+    "triton_distributed_tpu.kernels.reduce_scatter",
+    "triton_distributed_tpu.kernels.ep_all_to_all",
+    "triton_distributed_tpu.kernels.allgather_gemm",
+    "triton_distributed_tpu.kernels.gemm_reduce_scatter",
+    "triton_distributed_tpu.kernels.moe_overlap",
+    "triton_distributed_tpu.kernels.sp_attention",
+    "triton_distributed_tpu.analysis.mutants",
+)
+
+
+def register(name: str, *, worlds: tuple[int, ...] = (2, 4, 8),
+             hidden: bool = False):
+    """Decorator over a ``build(world) -> TraceSpec`` factory."""
+
+    def deco(build: Callable[[int], TraceSpec]):
+        if name in _REGISTRY:
+            raise ValueError(f"duplicate kernel registration: {name!r}")
+        _REGISTRY[name] = KernelEntry(
+            name=name, build=build, worlds=tuple(worlds),
+            module=build.__module__, hidden=hidden)
+        return build
+
+    return deco
+
+
+def _load_all() -> None:
+    for mod in _KERNEL_MODULES:
+        importlib.import_module(mod)
+
+
+def all_kernels(*, include_hidden: bool = False) -> list[KernelEntry]:
+    _load_all()
+    entries = sorted(_REGISTRY.values(), key=lambda e: e.name)
+    if not include_hidden:
+        entries = [e for e in entries if not e.hidden]
+    return entries
+
+
+def get(name: str) -> KernelEntry:
+    _load_all()
+    try:
+        return _REGISTRY[name]
+    except KeyError:
+        known = ", ".join(sorted(_REGISTRY))
+        raise KeyError(f"unknown kernel {name!r}; registered: {known}")

@@ -247,3 +247,42 @@ def _build_ag(mesh, axis, method, interpret, nd):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("ag.ring")
+def _comm_spec_ring(world: int) -> "_comm.TraceSpec":
+    m, rest = 8, (128,)
+    return _comm.TraceSpec(
+        body=_ring_ag_kernel,
+        args=[
+            _comm.Buf("x", (m, *rest)),
+            _comm.Buf("o", (world * m, *rest)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+@_comm.register("ag.a2a")
+def _comm_spec_a2a(world: int) -> "_comm.TraceSpec":
+    m, rest = 8, (128,)
+    return _comm.TraceSpec(
+        body=_a2a_ag_kernel,
+        args=[
+            _comm.Buf("x", (m, *rest)),
+            _comm.Buf("o", (world * m, *rest)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )

@@ -980,3 +980,34 @@ def _build_ag_gemm(mesh, axis, config, interpret):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("ag_gemm")
+def _comm_spec_ag_gemm(world: int) -> "_comm.TraceSpec":
+    m, k, bn, n_tiles = 8, 128, 128, 2
+    return _comm.TraceSpec(
+        body=_ag_gemm_kernel,
+        args=[
+            _comm.Buf("me", (1,), _np.int32,
+                      init=lambda r, w: _np.array([r], _np.int32)),
+            _comm.Buf("a", (m, k)),
+            _comm.Buf("b", (k, bn)),
+            _comm.Buf("o", (m, bn)),
+            _comm.Buf("a_full", (world, m, k)),
+            _comm.Buf("a_vmem", (2, m, k)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sems", (2,)),
+        ],
+        grid=(world, n_tiles),
+        kwargs=dict(axis="tp", world=world, n_tiles=n_tiles),
+    )

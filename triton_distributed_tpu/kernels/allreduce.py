@@ -316,3 +316,75 @@ def _build_ar(mesh, axis, method, interpret, nd):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+_COMM_M, _COMM_REST = 8, (128,)
+
+
+@_comm.register("ar.oneshot")
+def _comm_spec_oneshot(world: int) -> "_comm.TraceSpec":
+    m, rest = _COMM_M, _COMM_REST
+    return _comm.TraceSpec(
+        body=_oneshot_ar_kernel,
+        args=[
+            _comm.Buf("x", (m, *rest)),
+            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("staging", (world - 1, m, *rest)),
+            _comm.Sem("send_sems", (world,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("acc", (m, *rest)),
+            _comm.Buf("tmp", (m, *rest)),
+            _comm.Buf("out_vmem", (m, *rest)),
+        ],
+        kwargs=dict(axis="tp", world=world, br=m),
+    )
+
+
+@_comm.register("ar.oneshot_loopback")
+def _comm_spec_oneshot_loopback(world: int) -> "_comm.TraceSpec":
+    m, rest = _COMM_M, _COMM_REST
+    return _comm.TraceSpec(
+        body=_oneshot_ar_loopback_kernel,
+        ranks=1,  # single-chip self-loopback: world slots on one rank
+        args=[
+            _comm.Buf("x", (m, *rest)),
+            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("staging", (world - 1, m, *rest)),
+            _comm.Sem("seg_sems", (world - 1,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("acc", (m, *rest)),
+            _comm.Buf("tmp", (m, *rest)),
+            _comm.Buf("out_vmem", (m, *rest)),
+        ],
+        kwargs=dict(world=world, br=m),
+    )
+
+
+@_comm.register("ar.twoshot")
+def _comm_spec_twoshot(world: int) -> "_comm.TraceSpec":
+    m, rest = _COMM_M, _COMM_REST
+    return _comm.TraceSpec(
+        body=_twoshot_ar_kernel,
+        args=[
+            _comm.Buf("x", (world * m, *rest)),
+            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("staging", (world - 1, m, *rest)),
+            _comm.Buf("send_hbm", (m, *rest)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world - 1,)),
+            _comm.Sem("ag_send_sems", (world - 1,)),
+            _comm.Sem("ag_recv_sems", (world - 1,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("acc", (m, *rest)),
+            _comm.Buf("tmp", (m, *rest)),
+            _comm.Buf("out_vmem", (m, *rest)),
+        ],
+        kwargs=dict(axis="tp", world=world, br=m),
+    )

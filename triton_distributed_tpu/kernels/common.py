@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -116,10 +117,31 @@ def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):
 
 
 def dma_sems(shape: int | tuple):
-    """Scratch spec for an array of DMA semaphores (int n = 1-D of n)."""
+    """Scratch spec for an array of DMA semaphores (int n = 1-D of n).
+
+    Rejects empty and non-positive slot counts up front: a ``world - 1``-
+    style count goes to zero at ``world == 1`` and Mosaic's own error for a
+    zero-extent semaphore array (or the later out-of-range ``.at[i]``) says
+    nothing about where the count came from. Kernels must branch to their
+    single-device fallback (or skip the peer loop) *before* building the
+    grid spec rather than allocate a zero-slot semaphore array.
+    """
     if isinstance(shape, int):
         shape = (shape,)
-    return pltpu.SemaphoreType.DMA(tuple(shape))
+    shape = tuple(shape)
+    bad = [d for d in shape if not isinstance(d, (int, np.integer))]
+    if bad:
+        raise ValueError(
+            f"dma_sems({shape!r}): non-integer dimension(s) {bad!r} — "
+            "semaphore slot counts must be concrete Python ints (hoist the "
+            "count out of traced values in the kernel wrapper)")
+    if any(d <= 0 for d in shape):
+        raise ValueError(
+            f"dma_sems({shape!r}): non-positive slot count — a 'world - 1' "
+            "count hits zero at world == 1; take the kernel's single-device "
+            "fallback (or drop the peer loop) before building scratch_shapes "
+            "instead of allocating an empty semaphore array")
+    return pltpu.SemaphoreType.DMA(tuple(int(d) for d in shape))
 
 
 # Mosaic's scoped-VMEM stack limit per kernel (v5e/v5p default 16MB): the

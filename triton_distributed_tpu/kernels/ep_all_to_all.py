@@ -487,3 +487,69 @@ def _build_a2a_2d(mesh, ctx, payload_ndims, ici_axis, dcn_axis, interpret):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+_COMM_CAP, _COMM_CH, _COMM_H = 16, 8, 128
+
+
+def _comm_counts(rank: int, world: int) -> "_np.ndarray":
+    # Varied occupancancy per (src, dst) pair, including empty and full
+    # slots, so the predicated chunk pushes/waits are exercised end to end.
+    return _np.array([(3 * rank + 5 * p) % (_COMM_CAP + 1)
+                      for p in range(world)], _np.int32)
+
+
+def _comm_counts_block(rank: int, world: int) -> "_np.ndarray":
+    blk = _np.zeros((world, 8, 128), _np.int32)
+    blk[:, 0, 0] = _comm_counts(rank, world)
+    return blk
+
+
+def _comm_a2a_args(world: int):
+    return [
+        _comm.Buf("counts_sref", (world,), _np.int32, init=_comm_counts),
+        _comm.Buf("send", (world, _COMM_CAP, _COMM_H)),
+        _comm.Buf("counts_block", (world, 8, 128), _np.int32,
+                  init=_comm_counts_block),
+        _comm.Buf("recv", (world, _COMM_CAP, _COMM_H)),
+        _comm.Buf("rcounts_block", (world, 8, 128), _np.int32),
+    ]
+
+
+@_comm.register("ep.a2a")
+def _comm_spec_a2a_ep(world: int) -> "_comm.TraceSpec":
+    return _comm.TraceSpec(
+        body=_a2a_kernel,
+        args=_comm_a2a_args(world) + [
+            _comm.Sem("pay_sems", (2 * world - 1,)),
+            _comm.Sem("cnt_sems", (2 * world - 1,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("rcnt_smem", (8, 128), _np.int32),
+        ],
+        kwargs=dict(axis="ep", world=world, n_payloads=1,
+                    n_chunks=_COMM_CAP // _COMM_CH, ch=_COMM_CH),
+    )
+
+
+@_comm.register("ep.a2a_loopback")
+def _comm_spec_a2a_loopback(world: int) -> "_comm.TraceSpec":
+    return _comm.TraceSpec(
+        body=_a2a_loopback_kernel,
+        ranks=1,  # single-chip self-loopback: world slots on one rank
+        args=_comm_a2a_args(world) + [
+            _comm.Sem("pay_sems", (world,)),
+            _comm.Sem("cnt_sems", (world,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("rcnt_smem", (8, 128), _np.int32),
+        ],
+        kwargs=dict(world=world, n_payloads=1,
+                    n_chunks=_COMM_CAP // _COMM_CH, ch=_COMM_CH),
+    )

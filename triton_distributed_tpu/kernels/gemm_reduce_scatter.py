@@ -450,3 +450,39 @@ def _build_gemm_rs(mesh, axis, config, interpret):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("gemm_rs")
+def _comm_spec_gemm_rs(world: int) -> "_comm.TraceSpec":
+    m, k, bn, n_tiles = 8, 128, 128, 2
+    n = bn * n_tiles
+    return _comm.TraceSpec(
+        body=_gemm_rs_kernel,
+        args=[
+            _comm.Buf("me", (1,), _np.int32,
+                      init=lambda r, w: _np.array([r], _np.int32)),
+            _comm.Buf("a", (world * m, k)),
+            _comm.Buf("b", (k, bn)),
+            _comm.Buf("o", (m, n)),
+            _comm.Buf("staging", (world - 1, m, n)),
+            _comm.Buf("a_vmem", (m, k)),
+            _comm.Buf("send_tile", (2, m, bn)),
+            _comm.Buf("acc_tile", (m, bn)),
+            _comm.Buf("tmp_tile", (m, bn)),
+            _comm.Buf("out_tile", (m, bn)),
+            _comm.Sem("send_sems", (2,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        grid=(world, n_tiles),
+        kwargs=dict(axis="tp", world=world, n_tiles=n_tiles, bn=bn),
+    )

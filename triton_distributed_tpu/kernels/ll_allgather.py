@@ -214,3 +214,31 @@ def ll_all_gather_2d_device(x_local, staging, epoch, *, ici_axis: str = "ici",
         return intra, staging
     return (jax.lax.all_gather(intra, dcn_axis, axis=0, tiled=True),
             staging)
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("ag.ll")
+def _comm_spec_ll(world: int) -> "_comm.TraceSpec":
+    m, rest = 8, (128,)
+    return _comm.TraceSpec(
+        body=_ll_ag_kernel,
+        args=[
+            _comm.Buf("p", (1,), _np.int32),
+            _comm.Buf("x", (m, *rest)),
+            _comm.Buf("staging", (2, world - 1, m, *rest)),
+            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("staging_out", (1,)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (2, world)),
+            _comm.Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )

@@ -541,3 +541,63 @@ def ag_moe_mlp_2d_device(x_local, topk_ids_local, topk_weights_local,
         down, topk_ids_local, topk_weights_local, state["slot"],
         state["kept"])
     return out, state["n_dropped"]
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("moe.ag_group_gemm")
+def _comm_spec_ag_group_gemm(world: int) -> "_comm.TraceSpec":
+    n_e, cap, d, f = 2, 8, 128, 128      # n_k = n_f = 1
+    return _comm.TraceSpec(
+        body=_ag_group_gemm_kernel,
+        args=[
+            _comm.Buf("me", (1,), _np.int32,
+                      init=lambda r, w: _np.array([r], _np.int32)),
+            _comm.Buf("x", (n_e, cap, d)),
+            _comm.Buf("w", (1, d, f)),
+            _comm.Buf("o", (1, cap, f)),
+            _comm.Buf("a_full", (world - 1, n_e, cap, d)),
+            _comm.Buf("a_vmem", (cap, d)),
+            _comm.Buf("acc", (cap, f)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        grid=(world, n_e, 1, 1),
+        kwargs=dict(axis="tp", world=world, n_e=n_e, n_f=1, n_k=1, bk=d),
+    )
+
+
+@_comm.register("moe.group_gemm_rs")
+def _comm_spec_group_gemm_rs(world: int) -> "_comm.TraceSpec":
+    n_e, cap, f, bd = 2, 8, 128, 128     # n_k = n_d = 1; d = bd
+    return _comm.TraceSpec(
+        body=_group_gemm_rs_kernel,
+        args=[
+            _comm.Buf("me", (1,), _np.int32,
+                      init=lambda r, w: _np.array([r], _np.int32)),
+            _comm.Buf("a", (n_e, world * cap, f)),
+            _comm.Buf("w", (1, f, bd)),
+            _comm.Buf("o", (n_e, cap, bd)),
+            _comm.Buf("staging", (world - 1, n_e, cap, bd)),
+            _comm.Buf("a_vmem", (cap, f)),
+            _comm.Buf("send_tile", (2, cap, bd)),
+            _comm.Buf("part", (cap, bd)),
+            _comm.Buf("acc_tile", (cap, bd)),
+            _comm.Buf("tmp_tile", (cap, bd)),
+            _comm.Buf("out_tile", (cap, bd)),
+            _comm.Sem("send_sems", (2,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        grid=(world, n_e, 1, 1),
+        kwargs=dict(axis="tp", world=world, n_e=n_e, n_d=1, n_k=1,
+                    bd=bd, bk=f, cap=cap),
+    )

@@ -257,3 +257,51 @@ def _build_rs(mesh, axis, method, interpret, nd):
             check_vma=False,
         )
     )
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("rs.oneshot")
+def _comm_spec_oneshot_rs(world: int) -> "_comm.TraceSpec":
+    m, rest = 8, (128,)
+    return _comm.TraceSpec(
+        body=_oneshot_rs_kernel,
+        args=[
+            _comm.Buf("x", (world * m, *rest)),
+            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("staging", (world - 1, m, *rest)),
+            _comm.Sem("send_sems", (world,)),
+            _comm.Sem("recv_sems", (world,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("acc", (m, *rest)),
+            _comm.Buf("tmp", (m, *rest)),
+            _comm.Buf("out_vmem", (m, *rest)),
+        ],
+        kwargs=dict(axis="tp", world=world, br=m),
+    )
+
+
+@_comm.register("rs.ring")
+def _comm_spec_ring_rs(world: int) -> "_comm.TraceSpec":
+    m, rest = 8, (128,)
+    return _comm.TraceSpec(
+        body=_ring_rs_kernel,
+        args=[
+            _comm.Buf("x", (world * m, *rest)),
+            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("staging", (world - 1, m, *rest)),
+            _comm.Buf("send_hbm", (m, *rest)),
+            _comm.Sem("send_sems", (world - 1,)),
+            _comm.Sem("recv_sems", (world - 1,)),
+            _comm.Sem("copy_sem"),
+            _comm.Buf("acc", (m, *rest)),
+            _comm.Buf("tmp", (m, *rest)),
+            _comm.Buf("out_vmem", (m, *rest)),
+        ],
+        kwargs=dict(axis="tp", world=world, br=m),
+    )

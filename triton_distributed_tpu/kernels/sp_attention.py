@@ -970,3 +970,42 @@ def flash_decode_2d_device(q, k_cache_local, v_cache_local, *,
     outs2, lses2 = all_s[..., :dh], all_s[..., dh]
     w2 = jax.nn.softmax(lses2, axis=0)[..., None]
     return jnp.sum(w2 * outs2, axis=0).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Comm-safety analyzer registration (tools/comm_check.py; docs/analysis.md)
+# ---------------------------------------------------------------------------
+
+import numpy as _np  # noqa: E402
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+
+
+@_comm.register("sp.ag_attn")
+def _comm_spec_sp_ag_attn(world: int) -> "_comm.TraceSpec":
+    H, m, m_kv, dh = 2, 8, 8, 128
+    return _comm.TraceSpec(
+        body=_sp_attn_kernel,
+        args=[
+            _comm.Buf("scalars", (3,), _np.int32,
+                      init=lambda r, w: _np.array([r, r * 8, 0], _np.int32)),
+            _comm.Buf("q", (H, m, dh)),
+            _comm.Buf("k", (H, m_kv, dh)),
+            _comm.Buf("v", (H, m_kv, dh)),
+            _comm.Buf("o", (1, m, dh)),
+            _comm.Buf("k_full", (world, H, m_kv, dh)),
+            _comm.Buf("v_full", (world, H, m_kv, dh)),
+            _comm.Buf("q_vmem", (m, dh)),
+            _comm.Buf("k_vmem", (m_kv, dh)),
+            _comm.Buf("v_vmem", (m_kv, dh)),
+            _comm.Buf("acc", (m, dh)),
+            _comm.Buf("m_run", (m, 1)),
+            _comm.Buf("l_run", (m, 1)),
+            _comm.Sem("send_sems", (2 * (world - 1),)),
+            _comm.Sem("recv_sems", (2 * world,)),
+            _comm.Sem("copy_sem"),
+        ],
+        grid=(H, world),
+        kwargs=dict(axis="sp", world=world, causal=True, scale=1.0,
+                    partials=False),
+    )

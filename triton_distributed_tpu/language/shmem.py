@@ -84,8 +84,22 @@ def signal_op(sem_ref, peer=None, *, axis: str = "tp", inc: int = 1):
 
 def signal_wait_until(sem_ref, value: int):
     """Wait until the signal reaches ``value`` (``nvshmem_signal_wait_until``).
-    Decrements by ``value`` — TPU semaphores are consuming; callers that poll
-    the same cell repeatedly should re-signal or track epochs.
+
+    **Decrements by ``value`` on success — unlike the reference.** NVSHMEM's
+    ``nvshmem_signal_wait_until(sig, NVSHMEM_CMP_EQ, v)`` merely *observes*
+    the signal word: the cell still holds ``v`` afterwards and a second wait
+    on the same value returns immediately (kernels there reset cells with an
+    explicit store, e.g. the low-latency a2a's per-round ``signal = 0``).
+    TPU semaphores are *consuming*: this wait atomically subtracts ``value``,
+    so afterwards the cell is back to zero and a second identical wait blocks
+    until peers signal again. Consequences for porting:
+
+    - A CUDA kernel that waits the same cell twice per round needs ONE wait
+      here (the second would deadlock — ``tools/comm_check.py`` flags it).
+    - No reset store is needed between rounds/epochs: consumption *is* the
+      reset. Epoch-tracking ``cmp_eq`` counters become plain re-signals.
+    - Balance invariant: signals in == waits out per cell per round, which is
+      exactly what the analyzer's sem-balance check asserts at kernel exit.
 
     Only REGULAR/BARRIER semaphores can be waited this way; for the arrival of
     a ``putmem_*`` transfer (DMA ``recv_sem``) use ``wait_dma_arrival`` or the
@@ -115,7 +129,16 @@ def wait_send_bytes(src_ref, send_sem):
 
 def quiet(*dmas):
     """Wait for local completion of the given outstanding puts
-    (``nvshmem_quiet`` analog, scoped to explicit handles)."""
+    (``nvshmem_quiet`` analog, scoped to explicit handles).
+
+    With zero handles this is an explicit no-op, NOT a global drain:
+    NVSHMEM's ``nvshmem_quiet()`` waits for *all* outstanding puts of the
+    calling PE, but here DMA completion is tracked per-descriptor, so there
+    is no global set to wait on. Predicated code paths that sometimes issue
+    no puts may call ``quiet()`` unconditionally and rely on it doing
+    nothing."""
+    if not dmas:
+        return
     for dma in dmas:
         dma.wait_send()
 
