@@ -466,6 +466,106 @@ def _bench_paged_attn() -> dict:
     }
 
 
+def _bench_probe_overhead() -> dict:
+    """The ``--probe-overhead`` arm: device-telemetry cost of a probed
+    kernel build (kernels/probes.py) vs the plain build.
+
+    Runs paged decode attention — the one instrumented kernel that executes
+    on any backend (no barrier semaphores, so interpret mode works off-TPU)
+    — both ways, interleaved per round so drift cancels, and reports
+
+        probe_overhead_frac = (t_on - t_off) / t_off
+
+    as the headline metric. On real hardware the ≤5% contract is ENFORCED
+    (the arm raises, so the one-JSON-line result carries the error); under
+    the interpreter the measured fraction is recorded but not gated —
+    interpret-mode step time is Python dispatch, not device time, and the
+    probed build additionally serializes the slot grid dimension there.
+    Bit-identity of the probed output and decodability of the probe record
+    are asserted on every backend.
+    """
+    import time as _time
+
+    import numpy as np
+
+    from triton_distributed_tpu.kernels.paged_attention import (
+        paged_decode_attention,
+    )
+    from triton_distributed_tpu.obs import kprobe
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    B, Hq, Hkv, dh, bs, max_blocks, tile = 4, 4, 2, 128, 8, 4, 2
+    n_blocks = B * max_blocks
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.normal(size=(B, Hq, dh)), jnp.float32)
+    kp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
+    vp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
+    tables = jnp.asarray(rng.permutation(n_blocks).reshape(B, max_blocks),
+                         jnp.int32)
+    kv_lens = jnp.asarray(
+        rng.integers(1, max_blocks * bs + 1, size=B), jnp.int32)
+
+    @jax.jit
+    def f_off(q, kp, vp, tables, kv_lens):
+        return paged_decode_attention(q, kp, vp, tables, kv_lens,
+                                      tile_blocks=tile)
+
+    @jax.jit
+    def f_on(q, kp, vp, tables, kv_lens):
+        return paged_decode_attention(q, kp, vp, tables, kv_lens,
+                                      tile_blocks=tile, probes=True)
+
+    out_off = f_off(q, kp, vp, tables, kv_lens)
+    out_on, pbuf = f_on(q, kp, vp, tables, kv_lens)
+    jax.block_until_ready((out_off, out_on))
+    if not np.array_equal(np.asarray(out_off), np.asarray(out_on)):
+        raise RuntimeError("probed build output differs from plain build")
+    tr = kprobe.decode(pbuf)
+    if tr.n_steps != B * (max_blocks // tile):
+        raise RuntimeError(f"probe record has {tr.n_steps} steps, expected "
+                           f"{B * (max_blocks // tile)}")
+
+    rounds, iters = (8, 20) if on_tpu else (4, 3)
+
+    def once(f):
+        t0 = _time.perf_counter()
+        for _ in range(iters):
+            r = f(q, kp, vp, tables, kv_lens)
+        jax.block_until_ready(r)
+        return (_time.perf_counter() - t0) * 1e3 / iters
+
+    t_off, t_on = [], []
+    for _ in range(rounds):        # interleaved: drift hits both arms
+        t_off.append(once(f_off))
+        t_on.append(once(f_on))
+    ms_off, ms_on = min(t_off), min(t_on)
+    frac = (ms_on - ms_off) / ms_off
+    ok = (frac <= 0.05) or not on_tpu
+    extras = {
+        "probe_off_ms": round(ms_off, 6),
+        "probe_on_ms": round(ms_on, 6),
+        "probe_overhead_ok": ok,
+        "probe_overhead_gated": on_tpu,
+        "probe_steps": tr.n_steps,
+        "probe_kflops": tr.totals()["kflops"],
+    }
+    if not ok:
+        raise RuntimeError(
+            f"probe overhead {frac:.1%} exceeds the 5% step-time budget "
+            f"(off={ms_off:.4f}ms on={ms_on:.4f}ms)")
+    return {
+        "backend": devs[0].platform,
+        "metric": "probe_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -487,6 +587,25 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path, suite="paged_attn")
+        return
+
+    # --probe-overhead: device-telemetry step-time cost, probed vs plain
+    # build. Also BEFORE the backend probe: interpret mode runs it anywhere
+    # (bit-identity + decode asserted everywhere; the ≤5% gate binds on
+    # real hardware, where step time is device time).
+    if "--probe-overhead" in sys.argv:
+        try:
+            result = _bench_probe_overhead()
+        except Exception as e:  # noqa: BLE001
+            result = {
+                "backend": "error",
+                "metric": "probe_overhead_frac",
+                "value": None,
+                "unit": "frac",
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }
+        print(json.dumps(result))
+        _record_perfdb(result, perfdb_path, suite="probe_overhead")
         return
 
     # Backend probe FIRST: everything below (compile cache, device queries)

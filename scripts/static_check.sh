@@ -29,6 +29,30 @@ echo "== comm-safety analyzer (tools/comm_check.py) =="
 python -m tools.comm_check --world 2 --world 4 --world 8 || rc=1
 
 echo
+echo "== device-probe kernel variants (kernels/probes.py) =="
+# The '+probe' builds thread an extra telemetry output through every
+# instrumented kernel; they must stay registered (so the sweep above and
+# CI cover them) and individually clean at every world size.
+python - <<'EOF' || rc=1
+from triton_distributed_tpu.analysis import checks, registry
+from triton_distributed_tpu.kernels import probes
+
+names = {e.name for e in registry.all_kernels()}
+missing = [f"{b}+probe" for b in probes.PROBE_BASES
+           if f"{b}+probe" not in names]
+assert not missing, f"unregistered probe variants: {missing}"
+bad = {}
+for b in probes.PROBE_BASES:
+    for w in (2, 4, 8):
+        vs = checks.check_kernel(f"{b}+probe", w)
+        if vs:
+            bad[(b, w)] = [str(v) for v in vs]
+assert not bad, bad
+print(f"{len(probes.PROBE_BASES)} probe variants registered and clean "
+      "at world 2/4/8.")
+EOF
+
+echo
 echo "== bare-print lint (tools/check_no_bare_print.py) =="
 if python tools/check_no_bare_print.py; then
     echo "no bare prints."

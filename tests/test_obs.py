@@ -90,19 +90,28 @@ def test_chrome_trace_schema(tracer, tmp_path):
     path = tracer.export_chrome_trace(str(tmp_path / "td"))
     payload = json.loads(open(path).read())
     events = payload["traceEvents"]
-    assert len(events) == 4
-    by_phase = {e["ph"]: e for e in events}
+    meta = [e for e in events if e["ph"] == "M"]
+    data = [e for e in events if e["ph"] != "M"]
+    assert len(data) == 4
+    # Metadata events label the merged rows: one process_name per pid plus
+    # a thread_name per host thread seen in the buffer.
+    pid = payload["metadata"]["process_index"]
+    pnames = [e for e in meta if e["name"] == "process_name"]
+    assert [e["args"]["name"] for e in pnames] == [f"rank {pid}"]
+    assert any(e["name"] == "thread_name" for e in meta)
+    by_phase = {e["ph"]: e for e in data}
     assert set(by_phase) == {"X", "i", "b", "e"}
     x = by_phase["X"]
     assert x["name"] == "work" and x["dur"] >= 0 and x["args"] == {"k": 1}
-    for e in events:
+    for e in data:
         assert isinstance(e["ts"], float) and "pid" in e and "tid" in e
     assert by_phase["b"]["id"] == by_phase["e"]["id"] == "7"
     assert by_phase["i"]["s"] == "t"
     # Per-rank file naming + mergeability.
-    assert path.endswith(f"trace.p{payload['metadata']['process_index']}.json")
+    assert path.endswith(f"trace.p{pid}.json")
     merged = trace.merge_chrome_traces(str(tmp_path / "td"))
-    assert len(json.loads(open(merged).read())["traceEvents"]) == 4
+    merged_events = json.loads(open(merged).read())["traceEvents"]
+    assert len([e for e in merged_events if e["ph"] != "M"]) == 4
 
 
 def test_ring_buffer_bounded():

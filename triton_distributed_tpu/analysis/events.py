@@ -118,6 +118,10 @@ class TraceResult:
     ranks: int
     logs: list              # list[list[Event]], one per traced rank
     dmas: list              # list[DmaRecord]
+    # Final per-rank buffer contents, keyed (name, rank). Lets callers read
+    # back data the kernel produced during the trace — e.g. the device-probe
+    # buffers of the "+probe" variants (obs/kprobe.py decodes them).
+    store: dict | None = None
 
 
 # ---------------------------------------------------------------------------
@@ -669,4 +673,4 @@ def trace_kernel(spec: "_registry.TraceSpec", world: int) -> TraceResult:
                     tracer.grid_point = pt
                     spec.body(*refs, **dict(spec.kwargs))
     return TraceResult(world=world, ranks=ranks, logs=tracer.logs,
-                       dmas=tracer.dmas)
+                       dmas=tracer.dmas, store=tracer.store)

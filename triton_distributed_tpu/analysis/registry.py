@@ -87,6 +87,7 @@ _KERNEL_MODULES = (
     "triton_distributed_tpu.kernels.gemm_reduce_scatter",
     "triton_distributed_tpu.kernels.moe_overlap",
     "triton_distributed_tpu.kernels.sp_attention",
+    "triton_distributed_tpu.kernels.probes",
     "triton_distributed_tpu.analysis.mutants",
 )
 

@@ -43,6 +43,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
@@ -84,30 +85,35 @@ def choose_all_gather_method(world: int, nbytes: int,
 
 
 def _ring_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
-                    world: int):
+                    world: int, probe=_probes.NULL):
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0]
     right = jax.lax.rem(me + 1, world)
+    probe.enter(0, me, world)
 
     # All devices must have entered the kernel (so o_ref is live everywhere)
     # before anyone pushes into a peer's o_ref.
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     # Own shard into its slot.
-    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem,
+                      probe=probe)
 
     sends = []
     for s in range(world - 1):
         src = jax.lax.rem(me - s + world, world)  # chunk forwarded at step s
         dma = common.remote_copy(
             o_ref.at[pl.ds(src * m, m)], o_ref.at[pl.ds(src * m, m)],
-            send_sems.at[s], recv_sems.at[s], axis, right)
+            send_sems.at[s], recv_sems.at[s], axis, right, probe=probe)
         sends.append(dma)
         # Chunk (me-1-s) arrives from the left at step s; it is what we
         # forward at step s+1, so the wait doubles as the send dependency.
         rsrc = jax.lax.rem(me - 1 - s + world, world)
-        common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], recv_sems.at[s])
+        common.wait_recv(o_ref.at[pl.ds(rsrc * m, m)], recv_sems.at[s],
+                         probe=probe)
     for dma in sends:
+        probe.dma_wait(x_ref)
         dma.wait_send()
 
 
@@ -117,11 +123,13 @@ def _ring_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
 
 
 def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
-                   world: int):
+                   world: int, probe=_probes.NULL):
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0]
+    probe.enter(0, me, world)
 
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     sends = []
     for i in range(world - 1):
@@ -129,15 +137,18 @@ def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
         # Receiver waits slot ``src``; we are src ``me`` on every peer.
         dma = common.remote_copy(
             x_ref, o_ref.at[pl.ds(me * m, m)],
-            send_sems.at[i], recv_sems.at[me], axis, peer)
+            send_sems.at[i], recv_sems.at[me], axis, peer, probe=probe)
         sends.append(dma)
 
-    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem)
+    common.local_copy(x_ref, o_ref.at[pl.ds(me * m, m)], copy_sem,
+                      probe=probe)
 
     for i in range(world - 1):
         src = jax.lax.rem(me + 1 + i, world)
-        common.wait_recv(o_ref.at[pl.ds(src * m, m)], recv_sems.at[src])
+        common.wait_recv(o_ref.at[pl.ds(src * m, m)], recv_sems.at[src],
+                         probe=probe)
     for dma in sends:
+        probe.dma_wait(x_ref)
         dma.wait_send()
 
 
@@ -146,38 +157,60 @@ def _a2a_ag_kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, *, axis: str,
 # ---------------------------------------------------------------------------
 
 
-def _ag_call(kernel, x_local, *, axis: str, interpret, collective_id: int):
+def _ag_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
+             probes: bool = False):
     world = _axis_size(axis)
     if world == 1:
-        return x_local
+        return (x_local, _probes.host_stub_buffer()) if probes else x_local
     m = x_local.shape[0]
+    body = functools.partial(kernel, axis=axis, world=world)
+    out_shape = jax.ShapeDtypeStruct((world * m, *x_local.shape[1:]),
+                                     x_local.dtype)
+    out_specs = common.hbm_spec()
+    scratch = [
+        common.dma_sems(world - 1),   # send
+        common.dma_sems(world),       # recv (slot-per-src; ring uses [:world-1])
+        pltpu.SemaphoreType.DMA(()),  # local copy
+    ]
+    if probes:
+        # Separate build: probe buffer as last output, ordinal as last
+        # scratch (the disabled build above stays byte-identical).
+        def body(x_ref, o_ref, pbuf, send_sems, recv_sems, copy_sem, pord):
+            kernel(x_ref, o_ref, send_sems, recv_sems, copy_sem, axis=axis,
+                   world=world, probe=_probes.Probe(pbuf, pord, n_steps=1))
+
+        out_shape = [out_shape, _probes.out_shape(1)]
+        out_specs = [out_specs, _probes.out_spec()]
+        scratch = scratch + [_probes.ord_scratch()]
     return common.make_pallas_call(
-        functools.partial(kernel, axis=axis, world=world),
-        out_shape=jax.ShapeDtypeStruct((world * m, *x_local.shape[1:]),
-                                       x_local.dtype),
+        body,
+        out_shape=out_shape,
         in_specs=[common.any_spec()],
-        out_specs=common.hbm_spec(),
-        scratch_shapes=[
-            common.dma_sems(world - 1),   # send
-            common.dma_sems(world),       # recv (slot-per-src; ring uses [:world-1])
-            pltpu.SemaphoreType.DMA(()),  # local copy
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
         collective_id=collective_id,
         interpret=interpret,
     )(x_local)
 
 
-def ring_all_gather(x_local, *, axis: str = "tp", interpret=None):
+def ring_all_gather(x_local, *, axis: str = "tp", interpret=None,
+                    probes: bool = False):
     """Bandwidth-optimal ring allgather of ``x_local (m, ...)`` along ``axis``
-    → ``(world*m, ...)``, segment ``r`` holding rank ``r``'s shard."""
+    → ``(world*m, ...)``, segment ``r`` holding rank ``r``'s shard.
+    ``probes=True`` builds the instrumented variant and returns
+    ``(out, probe_buf)`` (see kernels/probes.py)."""
     return _ag_call(_ring_ag_kernel, x_local, axis=axis, interpret=interpret,
-                    collective_id=common.collective_id_for("ag_ring"))
+                    collective_id=common.collective_id_for("ag_ring"),
+                    probes=probes)
 
 
-def a2a_all_gather(x_local, *, axis: str = "tp", interpret=None):
-    """Latency-optimal direct-push allgather (see module docstring)."""
+def a2a_all_gather(x_local, *, axis: str = "tp", interpret=None,
+                   probes: bool = False):
+    """Latency-optimal direct-push allgather (see module docstring);
+    ``probes=True`` → ``(out, probe_buf)``."""
     return _ag_call(_a2a_ag_kernel, x_local, axis=axis, interpret=interpret,
-                    collective_id=common.collective_id_for("ag_a2a"))
+                    collective_id=common.collective_id_for("ag_a2a"),
+                    probes=probes)
 
 
 # ---------------------------------------------------------------------------

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
@@ -72,63 +73,86 @@ def choose_all_reduce_method(world: int, nbytes: int, leading_dim: int) -> AllRe
 
 def _oneshot_ar_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
                        acc_ref, tmp_ref, out_vmem, *, axis: str, world: int,
-                       br: int):
+                       br: int, probe=_probes.NULL):
     me = jax.lax.axis_index(axis)
     m = x_ref.shape[0]
+    probe.enter(0, me, world)
 
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     sends = []
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
         dma = common.remote_copy(
             x_ref, staging.at[common.peer_slot(me, peer)],
-            send_sems.at[i], recv_sems.at[me], axis, peer)
+            send_sems.at[i], recv_sems.at[me], axis, peer, probe=probe)
         sends.append(dma)
 
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
             common.wait_recv(staging.at[common.peer_slot(src, me)],
-                             recv_sems.at[src])
+                             recv_sems.at[src], probe=probe)
 
     # Fixed global reduce order 0..world-1 (own contribution read straight
     # from x_ref at its slot) — the replicated output is bitwise identical
     # across ranks (ADVICE r1: rank-relative order diverged); row-tiled VMEM.
     common.reduce_slots_tiled(
         x_ref, 0, staging, world, me, o_ref, m=m, br=br, acc_ref=acc_ref,
-        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem)
+        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem, probe=probe)
     for dma in sends:
+        probe.dma_wait(x_ref)
         dma.wait_send()
 
 
-def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None):
-    """Latency-optimal allreduce of ``x_local (m, ...)`` along ``axis``."""
+def oneshot_all_reduce(x_local, *, axis: str = "tp", interpret=None,
+                       probes: bool = False):
+    """Latency-optimal allreduce of ``x_local (m, ...)`` along ``axis``.
+    ``probes=True`` builds the instrumented variant and returns
+    ``(out, probe_buf)`` (see kernels/probes.py)."""
     world = _axis_size(axis)
     if world == 1:
-        return x_local
+        return (x_local, _probes.host_stub_buffer()) if probes else x_local
     shape = x_local.shape
     rest = shape[1:]
     br = common.stage_row_tile(shape[0], rest, x_local.dtype.itemsize)
+    body = functools.partial(_oneshot_ar_kernel, axis=axis, world=world,
+                             br=br)
     # Arrival staging is an ANY-space OUTPUT (discarded): Mosaic has no HBM
     # scratch; kernel arg order unchanged (first-scratch -> last-output).
-    return common.make_pallas_call(
-        functools.partial(_oneshot_ar_kernel, axis=axis, world=world, br=br),
-        out_shape=[jax.ShapeDtypeStruct(shape, x_local.dtype),
-                   jax.ShapeDtypeStruct((world - 1, *shape), x_local.dtype)],
+    out_shape = [jax.ShapeDtypeStruct(shape, x_local.dtype),
+                 jax.ShapeDtypeStruct((world - 1, *shape), x_local.dtype)]
+    out_specs = [common.hbm_spec()] * 2
+    scratch = [
+        common.dma_sems(world),
+        common.dma_sems(world),
+        pltpu.SemaphoreType.DMA(()),
+        pltpu.VMEM((br, *rest), jnp.float32),
+        pltpu.VMEM((br, *rest), x_local.dtype),
+        pltpu.VMEM((br, *rest), x_local.dtype),
+    ]
+    if probes:
+        def body(x_ref, o_ref, staging, pbuf, send_sems, recv_sems, copy_sem,
+                 acc_ref, tmp_ref, out_vmem, pord):
+            _oneshot_ar_kernel(
+                x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
+                acc_ref, tmp_ref, out_vmem, axis=axis, world=world, br=br,
+                probe=_probes.Probe(pbuf, pord, n_steps=1))
+
+        out_shape = out_shape + [_probes.out_shape(1)]
+        out_specs = out_specs + [_probes.out_spec()]
+        scratch = scratch + [_probes.ord_scratch()]
+    outs = common.make_pallas_call(
+        body,
+        out_shape=out_shape,
         in_specs=[common.any_spec()],
-        out_specs=[common.hbm_spec()] * 2,
-        scratch_shapes=[
-            common.dma_sems(world),
-            common.dma_sems(world),
-            pltpu.SemaphoreType.DMA(()),
-            pltpu.VMEM((br, *rest), jnp.float32),
-            pltpu.VMEM((br, *rest), x_local.dtype),
-            pltpu.VMEM((br, *rest), x_local.dtype),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch,
         collective_id=common.collective_id_for("ar_oneshot"),
         interpret=interpret,
-    )(x_local)[0]
+    )(x_local)
+    return (outs[0], outs[2]) if probes else outs[0]
 
 
 def _oneshot_ar_loopback_kernel(x_ref, o_ref, staging, seg_sems, copy_sem,

@@ -15,6 +15,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.runtime import compat as _compat  # noqa: F401
 from triton_distributed_tpu.runtime.platform import resolve_interpret
+from triton_distributed_tpu.kernels import probes as _probes
 
 # ---------------------------------------------------------------------------
 # Collective-id registry.
@@ -94,25 +95,42 @@ def cost_estimate(*, flops: int, bytes_accessed: int,
     return pl.CostEstimate(**kw)
 
 
-def local_copy(src_ref, dst_ref, sem):
+def local_copy(src_ref, dst_ref, sem, *, probe=_probes.NULL):
     """Synchronous local HBM<->VMEM/HBM copy via the DMA engine."""
+    probe.dma_issue(src_ref)
     dma = pltpu.make_async_copy(src_ref, dst_ref, sem)
     dma.start()
     dma.wait()
+    probe.dma_wait(src_ref)
 
 
-# Receiver-side arrival wait; single implementation lives in the language
-# layer (the shmem putmem_signal counterpart).
-from triton_distributed_tpu.language.shmem import wait_dma_arrival as wait_recv  # noqa: E402,F401
-from triton_distributed_tpu.language.shmem import wait_send_bytes as wait_send  # noqa: E402,F401
+def wait_recv(dst_ref, recv_sem, *, probe=_probes.NULL):
+    """Receiver-side arrival wait; the single implementation lives in the
+    language layer (the shmem putmem_signal counterpart). Thin wrapper so
+    the device-probe layer can count the wait and its bytes."""
+    from triton_distributed_tpu.language.shmem import wait_dma_arrival
+
+    probe.dma_wait(dst_ref)
+    return wait_dma_arrival(dst_ref, recv_sem)
 
 
-def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer):
+def wait_send(src_ref, send_sem, *, probe=_probes.NULL):
+    """Sender-side drain wait (shmem ``wait_send_bytes``); probe-counting
+    wrapper like :func:`wait_recv`."""
+    from triton_distributed_tpu.language.shmem import wait_send_bytes
+
+    probe.dma_wait(src_ref)
+    return wait_send_bytes(src_ref, send_sem)
+
+
+def remote_copy(src_ref, dst_ref, send_sem, recv_sem, axis: str, peer, *,
+                probe=_probes.NULL):
     """Start an async ICI put of ``src_ref`` into ``dst_ref`` on the device at
     rank ``peer`` along mesh ``axis`` (kernel-side argument order; delegates
     to the language layer's shmem primitive)."""
     from triton_distributed_tpu.language.shmem import putmem_nbi
 
+    probe.dma_issue(src_ref, remote=True)
     return putmem_nbi(src_ref, dst_ref, peer, send_sem, recv_sem, axis=axis)
 
 
@@ -195,6 +213,13 @@ def choose_lane_block(dim: int, vmem_of_block, what: str) -> int:
         f"{MOSAIC_VMEM_BUDGET >> 20}MB VMEM budget")
 
 
+def _elems(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
 def peer_slot(src, me):
     """Slot index of source ``src`` in a (world-1)-slot receive staging that
     omits the owner's own slot (sources in rank order, ``me`` removed).
@@ -204,7 +229,8 @@ def peer_slot(src, me):
 
 
 def reduce_slots_tiled(x_ref, x_off, staging, world, me, o_ref, *, m, br,
-                       acc_ref, tmp_ref, out_ref, copy_sem):
+                       acc_ref, tmp_ref, out_ref, copy_sem,
+                       probe=_probes.NULL):
     """Row-tiled fp32 reduce in FIXED global rank order (src = 0..world-1,
     bitwise rank-independent) shared by the one-shot AR / RS kernels:
     the own contribution reads straight from ``x_ref[x_off:]`` (no staging
@@ -220,23 +246,25 @@ def reduce_slots_tiled(x_ref, x_off, staging, world, me, o_ref, *, m, br,
             @pl.when(src == me)
             def _own(t=t, rows=rows):
                 local_copy(x_ref.at[pl.ds(x_off + t * br, rows)],
-                           tmp_ref.at[pl.ds(0, rows)], copy_sem)
+                           tmp_ref.at[pl.ds(0, rows)], copy_sem, probe=probe)
 
             @pl.when(src != me)
             def _remote(src=src, t=t, rows=rows):
                 local_copy(staging.at[peer_slot(src, me), pl.ds(t * br, rows)],
-                           tmp_ref.at[pl.ds(0, rows)], copy_sem)
+                           tmp_ref.at[pl.ds(0, rows)], copy_sem, probe=probe)
 
             if src == 0:
                 acc[...] = tmp[...].astype(jnp.float32)
             else:
                 acc[...] += tmp[...].astype(jnp.float32)
+                probe.compute(rows * _elems(tmp_ref.shape[1:]))
         out[...] = acc[...].astype(out_ref.dtype)
-        local_copy(out, o_ref.at[pl.ds(t * br, rows)], copy_sem)
+        local_copy(out, o_ref.at[pl.ds(t * br, rows)], copy_sem, probe=probe)
 
 
 def reduce_rows_tiled(x_ref, x_off, staging, stage_idx, dst_ref, dst_off, *,
-                      m, br, acc_ref, tmp_ref, out_ref, copy_sem):
+                      m, br, acc_ref, tmp_ref, out_ref, copy_sem,
+                      probe=_probes.NULL):
     """Row-tiled fp32 accumulate shared by the ring RS / two-shot AR kernels:
     ``dst_ref[dst_off+r] = x_ref[x_off+r] (+ staging[stage_idx][r])`` with
     VMEM held to ``(br, ...)`` tiles (ADVICE r1 VMEM-budget fix).
@@ -246,14 +274,17 @@ def reduce_rows_tiled(x_ref, x_off, staging, stage_idx, dst_ref, dst_off, *,
         acc = acc_ref.at[pl.ds(0, rows)]
         tmp = tmp_ref.at[pl.ds(0, rows)]
         out = out_ref.at[pl.ds(0, rows)]
-        local_copy(x_ref.at[pl.ds(x_off + t * br, rows)], tmp, copy_sem)
+        local_copy(x_ref.at[pl.ds(x_off + t * br, rows)], tmp, copy_sem,
+                   probe=probe)
         acc[...] = tmp[...].astype(jnp.float32)
         if stage_idx is not None:
             local_copy(staging.at[stage_idx, pl.ds(t * br, rows)], tmp,
-                       copy_sem)
+                       copy_sem, probe=probe)
             acc[...] += tmp[...].astype(jnp.float32)
+            probe.compute(rows * _elems(tmp_ref.shape[1:]))
         out[...] = acc[...].astype(out_ref.dtype)
-        local_copy(out, dst_ref.at[pl.ds(dst_off + t * br, rows)], copy_sem)
+        local_copy(out, dst_ref.at[pl.ds(dst_off + t * br, rows)], copy_sem,
+                   probe=probe)
 
 
 def make_pallas_call(kernel, *, out_shape, in_specs, out_specs, scratch_shapes,

@@ -48,6 +48,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
@@ -99,7 +100,7 @@ def _check_payload_alignment(payloads, resolved_interpret) -> None:
 
 
 def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
-                n_chunks: int, ch: int):
+                n_chunks: int, ch: int, probe=_probes.NULL):
     counts_sref = args[0]  # (world,) int32, scalar-prefetched send splits
     sends_in = args[1:n_payloads + 1]
     counts_ref = args[n_payloads + 1]
@@ -111,8 +112,10 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
     rcnt_smem = args[3 * n_payloads + 5]
 
     me = jax.lax.axis_index(axis)
+    probe.enter(0, me, world)
 
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     # Variable-size sends: each (peer, payload) pushes only the chunks that
     # hold real tokens — chunk c goes out iff c*ch < splits[peer]. The
@@ -126,7 +129,8 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
         # Splits first: the receiver needs them to size its waits.
         cnt_dmas.append(common.remote_copy(
             counts_ref.at[peer], rcounts_ref.at[me],
-            cnt_sems.at[i], cnt_sems.at[world - 1 + me], axis, peer))
+            cnt_sems.at[i], cnt_sems.at[world - 1 + me], axis, peer,
+            probe=probe))
         for p in range(n_payloads):
             for c in range(n_chunks):
                 @pl.when(c * ch < cnt)
@@ -135,18 +139,23 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
                         sends_in[p].at[peer, pl.ds(c * ch, ch)],
                         recvs_out[p].at[me, pl.ds(c * ch, ch)],
                         pay_sems[p].at[i],
-                        pay_sems[p].at[world - 1 + me], axis, peer)
+                        pay_sems[p].at[world - 1 + me], axis, peer,
+                        probe=probe)
 
     # Own slot: local copies (overlap with the DMA traffic).
     for p in range(n_payloads):
-        common.local_copy(sends_in[p].at[me], recvs_out[p].at[me], copy_sem)
-    common.local_copy(counts_ref.at[me], rcounts_ref.at[me], copy_sem)
+        common.local_copy(sends_in[p].at[me], recvs_out[p].at[me], copy_sem,
+                          probe=probe)
+    common.local_copy(counts_ref.at[me], rcounts_ref.at[me], copy_sem,
+                      probe=probe)
 
     for i in range(world - 1):
         src = jax.lax.rem(me + 1 + i, world)
-        common.wait_recv(rcounts_ref.at[src], cnt_sems.at[world - 1 + src])
+        common.wait_recv(rcounts_ref.at[src], cnt_sems.at[world - 1 + src],
+                         probe=probe)
         # Arrived splits -> SMEM so the chunk waits can predicate on them.
-        common.local_copy(rcounts_ref.at[src], rcnt_smem, copy_sem)
+        common.local_copy(rcounts_ref.at[src], rcnt_smem, copy_sem,
+                          probe=probe)
         rcnt = rcnt_smem[0, 0]
         for p in range(n_payloads):
             for c in range(n_chunks):
@@ -154,12 +163,13 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
                 def _wait(p=p, c=c, src=src):
                     common.wait_recv(
                         recvs_out[p].at[src, pl.ds(c * ch, ch)],
-                        pay_sems[p].at[world - 1 + src])
+                        pay_sems[p].at[world - 1 + src], probe=probe)
 
     # Drain local completion. Chunk pushes are predicated by the SAME
     # condition as their starts (a never-started DMA must not be waited);
     # their wait consumes the send semaphore by chunk bytes.
     for dma in cnt_dmas:
+        probe.dma_wait(counts_ref)
         dma.wait_send()
     for i in range(world - 1):
         peer = jax.lax.rem(me + 1 + i, world)
@@ -170,11 +180,12 @@ def _a2a_kernel(*args, axis: str, world: int, n_payloads: int,
                 def _drain(p=p, c=c, peer=peer, i=i):
                     common.wait_send(
                         sends_in[p].at[peer, pl.ds(c * ch, ch)],
-                        pay_sems[p].at[i])
+                        pay_sems[p].at[i], probe=probe)
 
 
 def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
-                    direction: str = "dispatch", interpret=None):
+                    direction: str = "dispatch", interpret=None,
+                    probes: bool = False):
     """Per-device exchange (composable inside shard_map).
 
     ``payloads``: one array or a tuple of arrays, each
@@ -184,6 +195,9 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
     collective id so the two directions never share barrier traffic.
     Returns ``(recv_payloads, recv_counts)`` in the same layout, slot p =
     from rank p. One kernel, no host round-trip (reference README.md:100).
+    With ``probes=True`` (a separate compile) returns
+    ``(recv_payloads, recv_counts, probe_buf)`` — the device-telemetry
+    record decoded by ``obs.kprobe``.
     """
     if direction not in ("dispatch", "combine"):
         raise ValueError(f"direction must be 'dispatch' or 'combine', got {direction!r}")
@@ -191,7 +205,10 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
     payloads = (payloads,) if single else tuple(payloads)
     world = _axis_size(ctx.axis)
     if world == 1:
-        return (payloads[0] if single else payloads), send_counts
+        out = (payloads[0] if single else payloads)
+        if probes:
+            return out, send_counts, _probes.host_stub_buffer()
+        return out, send_counts
     for pay in payloads:
         if pay.shape[0] != world or pay.shape[1] != ctx.capacity:
             raise ValueError(f"payload {pay.shape} != (world={world}, "
@@ -224,29 +241,50 @@ def fast_all_to_all(payloads, send_counts, *, ctx: AllToAllContext,
     # arrived block (via SMEM) — variable-size sends with matching waits.
     counts_block = jnp.zeros((world, 8, 128), jnp.int32
                              ).at[:, 0, 0].set(send_counts)
+    kernel = functools.partial(_a2a_kernel, axis=ctx.axis, world=world,
+                               n_payloads=n, n_chunks=n_chunks, ch=ch)
+    out_specs = [common.hbm_spec()] * (n + 1)
+    out_shape = (
+        tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in payloads)
+        + (jax.ShapeDtypeStruct((world, 8, 128), jnp.int32),)
+    )
+    scratch_shapes = (
+        [common.dma_sems(2 * world - 1) for _ in range(n)]
+        + [common.dma_sems(2 * world - 1), pltpu.SemaphoreType.DMA(()),
+           pltpu.SMEM((8, 128), jnp.int32)]
+    )
+    if probes:
+        # Probe buffer rides after the base outputs; ordinal scratch last.
+        # Args: counts_sref, inputs (n+1), outputs (n+1), pbuf, scratch, pord.
+        def body(*refs, kernel=kernel):
+            pbuf = refs[2 * n + 3]
+            pord = refs[-1]
+            rest = refs[:2 * n + 3] + refs[2 * n + 4:-1]
+            kernel(*rest, probe=_probes.Probe(pbuf, pord, n_steps=1))
+
+        kernel = body
+        out_specs = [*out_specs, _probes.out_spec()]
+        out_shape = out_shape + (_probes.out_shape(1),)
+        scratch_shapes = [*scratch_shapes, _probes.ord_scratch()]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(),
         in_specs=[common.any_spec()] * (n + 1),
-        out_specs=tuple([common.hbm_spec()] * (n + 1)),
-        scratch_shapes=(
-            [common.dma_sems(2 * world - 1) for _ in range(n)]
-            + [common.dma_sems(2 * world - 1), pltpu.SemaphoreType.DMA(()),
-               pltpu.SMEM((8, 128), jnp.int32)]
-        ),
+        out_specs=tuple(out_specs),
+        scratch_shapes=scratch_shapes,
     )
     result = pl.pallas_call(
-        functools.partial(_a2a_kernel, axis=ctx.axis, world=world,
-                          n_payloads=n, n_chunks=n_chunks, ch=ch),
-        out_shape=(
-            tuple(jax.ShapeDtypeStruct(p.shape, p.dtype) for p in payloads)
-            + (jax.ShapeDtypeStruct((world, 8, 128), jnp.int32),)
-        ),
+        kernel,
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for(f"ep_a2a_{direction}")),
         interpret=resolve_interpret(interpret),
     )(send_counts, *payloads, counts_block)
+    if probes:
+        *out, rcounts_block, pbuf = result
+        rcounts = rcounts_block[:, 0, 0]
+        return (out[0] if single else tuple(out)), rcounts, pbuf
     *out, rcounts_block = result
     rcounts = rcounts_block[:, 0, 0]
     return (out[0] if single else tuple(out)), rcounts

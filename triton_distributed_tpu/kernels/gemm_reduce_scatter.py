@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 from triton_distributed_tpu.runtime.platform import resolve_interpret
@@ -74,11 +75,14 @@ class GEMMRSConfig:
 
 def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
                     acc_tile, tmp_tile, out_tile, send_sems, recv_sems,
-                    copy_sem, *, axis: str, world: int, n_tiles: int, bn: int):
+                    copy_sem, *, axis: str, world: int, n_tiles: int, bn: int,
+                    probe=_probes.NULL):
     s = pl.program_id(0)
     j = pl.program_id(1)
     me = me_ref[0]
     m = o_ref.shape[0]
+    k_local = a_vmem.shape[1]
+    probe.enter(s * n_tiles + j, me, world)
     # Remote segments first (their pushes overlap later compute); own last.
     dst = jax.lax.rem(me + 1 + s, world)
     is_own = s == world - 1
@@ -94,20 +98,24 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
     @pl.when((s == 0) & (j == 0))
     def _startup():
         dl.barrier_all(axis)  # staging live everywhere before pushes land
+        probe.sem_spin(world - 1)
 
     # Load this destination's A rows once per segment.
     @pl.when(j == 0)
     def _load():
-        common.local_copy(a_ref.at[pl.ds(dst * m, m)], a_vmem, copy_sem)
+        common.local_copy(a_ref.at[pl.ds(dst * m, m)], a_vmem, copy_sem,
+                          probe=probe)
 
     # Reusing a send_tile parity slot: its push (started at tile t-2, same
     # parity) must have locally drained.
     @pl.when(~is_own & (t >= 2))
     def _reclaim():
-        common.wait_send(send_tile.at[parity], send_sems.at[parity])
+        common.wait_send(send_tile.at[parity], send_sems.at[parity],
+                         probe=probe)
 
     partial = jnp.dot(a_vmem[...], b_ref[...],
                       preferred_element_type=jnp.float32)
+    probe.compute(2 * m * k_local * bn)
 
     # Tile complete -> push it to its owner's staging column immediately
     # (async; overlaps every later matmul — the reference's per-tile notify +
@@ -118,7 +126,7 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
         common.remote_copy(
             send_tile.at[parity],
             staging.at[common.peer_slot(me, dst), :, pl.ds(j * bn, bn)],
-            send_sems.at[parity], recv_sems.at[me], axis, dst)
+            send_sems.at[parity], recv_sems.at[me], axis, dst, probe=probe)
 
     # Own segment (last): fold the world-1 remote partials per tile, in a
     # FIXED global rank order so the reduction bits are rank-independent
@@ -131,7 +139,7 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
                 @pl.when(src != me)
                 def _wait(src=src):
                     common.wait_recv(staging.at[common.peer_slot(src, me)],
-                                     recv_sems.at[src])
+                                     recv_sems.at[src], probe=probe)
 
         acc_tile[...] = jnp.zeros_like(acc_tile)
         for src in range(world):
@@ -144,24 +152,32 @@ def _gemm_rs_kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
                 common.local_copy(
                     staging.at[common.peer_slot(src, me), :,
                                pl.ds(j * bn, bn)],
-                    tmp_tile, copy_sem)
+                    tmp_tile, copy_sem, probe=probe)
                 acc_tile[...] += tmp_tile[...].astype(jnp.float32)
+        probe.compute(world * m * bn)
         out_tile[...] = acc_tile[...].astype(out_tile.dtype)
-        common.local_copy(out_tile, o_ref.at[:, pl.ds(j * bn, bn)], copy_sem)
+        common.local_copy(out_tile, o_ref.at[:, pl.ds(j * bn, bn)], copy_sem,
+                          probe=probe)
 
         # Drain the last push per parity slot (every earlier push was
         # reclaimed by the t-2 wait above).
         @pl.when(j == n_tiles - 1)
         def _drain():
             for p in range(min(2, total_remote)):
-                common.wait_send(send_tile.at[p], send_sems.at[p])
+                common.wait_send(send_tile.at[p], send_sems.at[p],
+                                 probe=probe)
 
 
 def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
-                   config: GEMMRSConfig | None = None, interpret=None):
+                   config: GEMMRSConfig | None = None, interpret=None,
+                   probes: bool = False):
     """Per-device GEMM-RS (composable inside shard_map):
     ``(M, k_local) x (k_local, N) -> (m, N)`` — segment ``me`` of the
-    reduce-scattered full product, comm overlapped into the matmul."""
+    reduce-scattered full product, comm overlapped into the matmul.
+
+    With ``probes=True`` (a separate compile) returns ``(out, probe_buf)``
+    where ``probe_buf`` is the device-telemetry record decoded by
+    ``obs.kprobe`` (one row per grid step)."""
     config = config or GEMMRSConfig()
     world = _axis_size(axis)
     M, k_local = a_local.shape
@@ -171,7 +187,8 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
         # No block override: an explicit block would forfeit the automatic
         # XLA delegation on ragged/VMEM-infeasible shapes (world==1 is the
         # degenerate path; config.block_n tiles the multi-device grid only).
-        return ag_gemm_single_chip(a_local, b_local, interpret=interpret)
+        out = ag_gemm_single_chip(a_local, b_local, interpret=interpret)
+        return (out, _probes.host_stub_buffer()) if probes else out
     if M % world:
         raise ValueError(f"M {M} not divisible by world {world}")
     m = M // world
@@ -187,35 +204,55 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
     # does not allocate HBM scratch, and peer pushes need a stable HBM buffer
     # on every device — kernel arg order is unchanged (first-scratch ->
     # last-output position).
+    in_specs = [
+        pl.BlockSpec(memory_space=pl.ANY),                    # a_local
+        pl.BlockSpec((k_local, bn), lambda s, j, me_ref: (0, j)),
+    ]
+    out_specs = [
+        common.hbm_spec(),                                    # (m, N)
+        common.hbm_spec(),                                    # staging
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((m, k_local), a_local.dtype),  # dst-segment A rows
+        pltpu.VMEM((2, m, bn), out_dtype),        # per-tile send buffer
+        pltpu.VMEM((m, bn), jnp.float32),         # own-tile accumulator
+        pltpu.VMEM((m, bn), out_dtype),           # remote-partial tile
+        pltpu.VMEM((m, bn), out_dtype),           # cast-out tile
+        common.dma_sems(2),                       # send (by tile parity)
+        common.dma_sems(world),                   # recv (slot per src)
+        pltpu.SemaphoreType.DMA(()),
+    ]
+    kernel = functools.partial(_gemm_rs_kernel, axis=axis, world=world,
+                               n_tiles=n_tiles, bn=bn)
+    out_shape = [
+        jax.ShapeDtypeStruct((m, n), out_dtype),
+        jax.ShapeDtypeStruct((world - 1, m, n), out_dtype),
+    ]
+    if probes:
+        n_steps = world * n_tiles
+
+        def body(me_ref, a_ref, b_ref, o_ref, staging, pbuf, a_vmem,
+                 send_tile, acc_tile, tmp_tile, out_tile, send_sems,
+                 recv_sems, copy_sem, pord, kernel=kernel):
+            kernel(me_ref, a_ref, b_ref, o_ref, staging, a_vmem, send_tile,
+                   acc_tile, tmp_tile, out_tile, send_sems, recv_sems,
+                   copy_sem,
+                   probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
+
+        kernel = body
+        out_specs = [*out_specs, _probes.out_spec()]
+        scratch_shapes = [*scratch_shapes, _probes.ord_scratch()]
+        out_shape = [*out_shape, _probes.out_shape(n_steps)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(world, n_tiles),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),                    # a_local
-            pl.BlockSpec((k_local, bn), lambda s, j, me_ref: (0, j)),
-        ],
-        out_specs=[
-            common.hbm_spec(),                                    # (m, N)
-            common.hbm_spec(),                                    # staging
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((m, k_local), a_local.dtype),  # dst-segment A rows
-            pltpu.VMEM((2, m, bn), out_dtype),        # per-tile send buffer
-            pltpu.VMEM((m, bn), jnp.float32),         # own-tile accumulator
-            pltpu.VMEM((m, bn), out_dtype),           # remote-partial tile
-            pltpu.VMEM((m, bn), out_dtype),           # cast-out tile
-            common.dma_sems(2),                       # send (by tile parity)
-            common.dma_sems(world),                   # recv (slot per src)
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
-    out, _ = pl.pallas_call(
-        functools.partial(_gemm_rs_kernel, axis=axis, world=world,
-                          n_tiles=n_tiles, bn=bn),
-        out_shape=[
-            jax.ShapeDtypeStruct((m, n), out_dtype),
-            jax.ShapeDtypeStruct((world - 1, m, n), out_dtype),
-        ],
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("gemm_rs")),
@@ -227,7 +264,7 @@ def gemm_rs_device(a_local, b_local, *, axis: str = "tp",
             remote_bytes=(world - 1) * m * n * out_dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, a_local, b_local)
-    return out
+    return (outs[0], outs[2]) if probes else outs[0]
 
 
 def _gemm_rs_loopback_kernel(a_ref, b_ref, o_ref, staging, a_vmem, send_tile,

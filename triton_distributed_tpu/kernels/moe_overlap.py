@@ -52,6 +52,7 @@ from jax.experimental.pallas import tpu as pltpu
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
 from triton_distributed_tpu.kernels import moe_utils
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.platform import resolve_interpret
 
@@ -84,26 +85,29 @@ class MoEOverlapConfig:
 def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
                           acc_ref, send_sems, recv_sems, copy_sem, *,
                           axis: str, world: int, n_e: int, n_f: int,
-                          n_k: int, bk: int):
+                          n_k: int, bk: int, probe=_probes.NULL):
     s = pl.program_id(0)
     e = pl.program_id(1)
     j = pl.program_id(2)
     kk = pl.program_id(3)
     me = me_ref[0]
+    probe.enter(((s * n_e + e) * n_f + j) * n_k + kk, me, world)
     src = jax.lax.rem(me + s, world)  # own grid first, then by distance
 
     @pl.when((s == 0) & (e == 0) & (j == 0) & (kk == 0))
     def _startup():
         dl.barrier_all(axis)
+        probe.sem_spin(world - 1)
         for i in range(world - 1):
             peer = jax.lax.rem(me + 1 + i, world)
             common.remote_copy(x_ref, a_full.at[common.peer_slot(me, peer)],
-                               send_sems.at[i], recv_sems.at[me], axis, peer)
+                               send_sems.at[i], recv_sems.at[me], axis, peer,
+                               probe=probe)
 
     @pl.when((e == 0) & (j == 0) & (kk == 0) & (s > 0))
     def _arrive():
         common.wait_recv(a_full.at[common.peer_slot(src, me)],
-                         recv_sems.at[src])
+                         recv_sems.at[src], probe=probe)
 
     # (cap, bk) contraction tile: own grid reads straight from x_ref (no
     # staging round-trip; a_full holds only the world-1 remote arrivals).
@@ -111,12 +115,12 @@ def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
 
     @pl.when(s == 0)
     def _load_own():
-        common.local_copy(x_ref.at[e, :, ks], a_vmem, copy_sem)
+        common.local_copy(x_ref.at[e, :, ks], a_vmem, copy_sem, probe=probe)
 
     @pl.when(s > 0)
     def _load_remote():
         common.local_copy(a_full.at[common.peer_slot(src, me), e, :, ks],
-                          a_vmem, copy_sem)
+                          a_vmem, copy_sem, probe=probe)
 
     @pl.when(kk == 0)
     def _zero():
@@ -124,6 +128,7 @@ def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
 
     acc_ref[...] += jnp.dot(a_vmem[...], w_ref[0],
                             preferred_element_type=jnp.float32)
+    probe.compute(2 * a_vmem.shape[0] * bk * acc_ref.shape[1])
 
     @pl.when(kk == n_k - 1)
     def _store():
@@ -133,13 +138,13 @@ def _ag_group_gemm_kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem,
              & (kk == n_k - 1))
     def _drain():
         for i in range(world - 1):
-            common.wait_send(x_ref, send_sems.at[i])
+            common.wait_send(x_ref, send_sems.at[i], probe=probe)
 
 
 def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
                          n_experts: int, capacity: int, axis: str = "tp",
                          config: MoEOverlapConfig | None = None,
-                         interpret=None):
+                         interpret=None, probes: bool = False):
     """AG of per-expert capacity grids + grouped GEMM in one kernel.
 
     x_local (m, d), topk_ids_local (m, k), w_up_local (E, d, f_local)
@@ -150,7 +155,9 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     expert GEMMs. ``state`` carries the local routing bookkeeping —
     ``slot``/``kept`` for ``combine_from_experts`` (topk weights are passed
     there directly), plus ``n_dropped``: capacity overflow is observable,
-    never silent (ADVICE r1)."""
+    never silent (ADVICE r1). With ``probes=True`` (a separate compile)
+    returns ``(up, state, probe_buf)`` — device telemetry decoded by
+    ``obs.kprobe``."""
     config = config or MoEOverlapConfig()
     world = _axis_size(axis)
     m, d = x_local.shape
@@ -169,7 +176,10 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
     if world == 1:
         up = jnp.einsum("ecd,edf->ecf", grid_x, w_up_local,
                         preferred_element_type=jnp.float32)
-        return up.astype(out_dtype), state
+        up = up.astype(out_dtype)
+        if probes:
+            return up, state, _probes.host_stub_buffer()
+        return up, state
 
     if _ledger.enabled():
         from triton_distributed_tpu.runtime import perf_model as pm
@@ -181,6 +191,42 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
             est_s=pm.est_push_all_gather(grid_x.nbytes, world))
 
     me = jax.lax.axis_index(axis).astype(jnp.int32)[None]
+    out_specs = [
+        pl.BlockSpec(
+            (1, capacity, bf),
+            lambda s, e, j, kk, me_ref:
+                (e, jax.lax.rem(me_ref[0] + s, world), j),
+        ),
+        # Remote-arrival staging: HBM OUTPUT (discarded) — Mosaic
+        # has no HBM scratch; arg order unchanged.
+        common.hbm_spec(),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((capacity, bk), x_local.dtype),
+        pltpu.VMEM((capacity, bf), jnp.float32),
+        common.dma_sems(world - 1),
+        common.dma_sems(world),
+        pltpu.SemaphoreType.DMA(()),
+    ]
+    kernel = functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
+                               n_e=E, n_f=n_f, n_k=n_k, bk=bk)
+    out_shape = [
+        jax.ShapeDtypeStruct((E, world * capacity, f_local), out_dtype),
+        jax.ShapeDtypeStruct((world - 1, E, capacity, d), x_local.dtype),
+    ]
+    if probes:
+        n_steps = world * E * n_f * n_k
+
+        def body(me_ref, x_ref, w_ref, o_ref, a_full, pbuf, a_vmem, acc_ref,
+                 send_sems, recv_sems, copy_sem, pord, kernel=kernel):
+            kernel(me_ref, x_ref, w_ref, o_ref, a_full, a_vmem, acc_ref,
+                   send_sems, recv_sems, copy_sem,
+                   probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
+
+        kernel = body
+        out_specs = [*out_specs, _probes.out_spec()]
+        scratch_shapes = [*scratch_shapes, _probes.ord_scratch()]
+        out_shape = [*out_shape, _probes.out_shape(n_steps)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(world, E, n_f, n_k),
@@ -188,31 +234,12 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
             pl.BlockSpec(memory_space=pl.ANY),                # local grid
             pl.BlockSpec((1, bk, bf), lambda s, e, j, kk, me_ref: (e, kk, j)),
         ],
-        out_specs=[
-            pl.BlockSpec(
-                (1, capacity, bf),
-                lambda s, e, j, kk, me_ref:
-                    (e, jax.lax.rem(me_ref[0] + s, world), j),
-            ),
-            # Remote-arrival staging: HBM OUTPUT (discarded) — Mosaic
-            # has no HBM scratch; arg order unchanged.
-            common.hbm_spec(),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((capacity, bk), x_local.dtype),
-            pltpu.VMEM((capacity, bf), jnp.float32),
-            common.dma_sems(world - 1),
-            common.dma_sems(world),
-            pltpu.SemaphoreType.DMA(()),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
-    up, _ = pl.pallas_call(
-        functools.partial(_ag_group_gemm_kernel, axis=axis, world=world,
-                          n_e=E, n_f=n_f, n_k=n_k, bk=bk),
-        out_shape=[
-            jax.ShapeDtypeStruct((E, world * capacity, f_local), out_dtype),
-            jax.ShapeDtypeStruct((world - 1, E, capacity, d), x_local.dtype),
-        ],
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=common.compiler_params(
             common.collective_id_for("ag_group_gemm")),
@@ -227,7 +254,9 @@ def ag_group_gemm_device(x_local, topk_ids_local, w_up_local, *,
             * x_local.dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(me, grid_x, w_up_local)
-    return up, state
+    if probes:
+        return outs[0], state, outs[2]
+    return outs[0], state
 
 
 # ---------------------------------------------------------------------------

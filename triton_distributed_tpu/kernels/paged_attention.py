@@ -43,6 +43,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.runtime.platform import on_tpu, resolve_interpret
 
 _NEG_INF = -1e30
@@ -156,7 +157,8 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
 def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
                          k_buf, v_buf, acc_ref, m_ref, l_ref, sems, *,
                          n_tiles: int, tile_blocks: int, bs: int,
-                         n_blocks: int, scale: float, n_kv: int):
+                         n_blocks: int, scale: float, n_kv: int,
+                         probe=_probes.NULL):
     """One (slot, block-tile) grid step of fused paged decode attention.
 
     ``tbl_ref`` (B, max_blocks) int32 and ``kvlen_ref`` (B,) int32 arrive
@@ -171,6 +173,9 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
     """
     b = pl.program_id(0)
     t = pl.program_id(1)
+    # Single-device kernel: probe rank 0 / world 1; absolute (slot, tile)
+    # step so the decoder labels rows per batch slot.
+    probe.enter(b * n_tiles + t, 0, 1)
     kv_len = kvlen_ref[b]
     base = t * tile_blocks * bs
 
@@ -190,9 +195,11 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
                 blk = jnp.clip(tbl_ref[b, t * tile_blocks + i], 0,
                                n_blocks - 1)
                 common.local_copy(kp_ref.at[blk],
-                                  k_buf.at[pl.ds(i * bs, bs)], sems.at[0])
+                                  k_buf.at[pl.ds(i * bs, bs)], sems.at[0],
+                                  probe=probe)
                 common.local_copy(vp_ref.at[blk],
-                                  v_buf.at[pl.ds(i * bs, bs)], sems.at[1])
+                                  v_buf.at[pl.ds(i * bs, bs)], sems.at[1],
+                                  probe=probe)
 
         # Staging rows whose block was never fetched hold garbage (NaN in
         # interpret mode, stale VMEM on hardware). The score-side position
@@ -225,6 +232,9 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
             acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
                 p, v, (((1,), (0,)), ((), ())))              # (g, dh)
             m_ref[h] = new_max
+        # QK^T + PV dots over the staged rows, all kv heads this tile.
+        probe.compute(4 * n_kv * (q_ref.shape[2]) * tile_blocks * bs
+                      * q_ref.shape[3])
 
     @pl.when(t == n_tiles - 1)
     def _finish():
@@ -248,7 +258,8 @@ def paged_attn_cost(B: int, max_blocks: int, block_size: int,
 
 def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                            slot_mask=None, scale: float | None = None,
-                           tile_blocks: int | None = None, interpret=None):
+                           tile_blocks: int | None = None, interpret=None,
+                           probes: bool = False):
     """GQA decode attention directly over a block-paged KV pool.
 
     q:            (B, Hq, dh) — one new (rope'd) query row per slot.
@@ -269,6 +280,11 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                   The dead rows' outputs are garbage the caller discards.
     tile_blocks:  pool blocks staged per grid step (None = autotuned /
                   heuristic, ``tuned_paged_tile``).
+    probes:       device-telemetry build (a separate compile): returns
+                  ``(out, probe_buf)`` with one record row per (slot, tile)
+                  grid step, decoded by ``obs.kprobe``. The probed build
+                  serializes the slot dimension (``arbitrary`` semantics)
+                  so record ordinals are deterministic.
 
     Returns (B, Hq, dh) in q.dtype. Bit-compatible with the reference
     ``paged_gather_kv`` + dense/flash decode composition (streaming softmax
@@ -304,6 +320,38 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
         block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
 
     qg = q.reshape(B, Hkv, g, dh)
+    kernel = functools.partial(_paged_decode_kernel, n_tiles=n_tiles,
+                               tile_blocks=tile_blocks, bs=bs,
+                               n_blocks=n_blocks, scale=scale, n_kv=Hkv)
+    out_specs = pl.BlockSpec((1, Hkv, g, dh),
+                             lambda b, t, tbl, kl: (b, 0, 0, 0))
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, g, dh), jnp.float32)
+    scratch_shapes = [
+        pltpu.VMEM((tile_blocks * bs, Hkv, dh), k_pool.dtype),  # k stage
+        pltpu.VMEM((tile_blocks * bs, Hkv, dh), v_pool.dtype),  # v stage
+        pltpu.VMEM((Hkv, g, dh), jnp.float32),   # acc
+        pltpu.VMEM((Hkv, g, 1), jnp.float32),    # running max
+        pltpu.VMEM((Hkv, g, 1), jnp.float32),    # denominator
+        common.dma_sems(2),
+    ]
+    # The probed build serializes the slot dimension so the single ordinal
+    # counter ticks in deterministic grid order.
+    dim_sems = ("arbitrary", "arbitrary") if probes \
+        else ("parallel", "arbitrary")
+    if probes:
+        n_steps = B * n_tiles
+
+        def body(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref, pbuf,
+                 k_buf, v_buf, acc_ref, m_ref, l_ref, sems, pord,
+                 kernel=kernel):
+            kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref, k_buf,
+                   v_buf, acc_ref, m_ref, l_ref, sems,
+                   probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
+
+        kernel = body
+        out_specs = [out_specs, _probes.out_spec()]
+        scratch_shapes = [*scratch_shapes, _probes.ord_scratch()]
+        out_shape = [out_shape, _probes.out_shape(n_steps)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, n_tiles),
@@ -312,28 +360,21 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
             common.any_spec(),     # k pool: manual per-block DMA
             common.any_spec(),     # v pool
         ],
-        out_specs=pl.BlockSpec((1, Hkv, g, dh),
-                               lambda b, t, tbl, kl: (b, 0, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((tile_blocks * bs, Hkv, dh), k_pool.dtype),  # k stage
-            pltpu.VMEM((tile_blocks * bs, Hkv, dh), v_pool.dtype),  # v stage
-            pltpu.VMEM((Hkv, g, dh), jnp.float32),   # acc
-            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # running max
-            pltpu.VMEM((Hkv, g, 1), jnp.float32),    # denominator
-            common.dma_sems(2),
-        ],
+        out_specs=out_specs,
+        scratch_shapes=scratch_shapes,
     )
-    out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, n_tiles=n_tiles,
-                          tile_blocks=tile_blocks, bs=bs, n_blocks=n_blocks,
-                          scale=scale, n_kv=Hkv),
-        out_shape=jax.ShapeDtypeStruct((B, Hkv, g, dh), jnp.float32),
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=out_shape,
         grid_spec=grid_spec,
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary")),
+            dimension_semantics=dim_sems),
         cost_estimate=paged_attn_cost(
             B, max_blocks, bs, Hkv, dh, n_q_heads=Hq,
             itemsize=k_pool.dtype.itemsize),
         interpret=resolve_interpret(interpret),
     )(block_tables, kv_lens, qg, k_pool, v_pool)
-    return out.reshape(B, Hq, dh).astype(q.dtype)
+    if probes:
+        out = outs[0].reshape(B, Hq, dh).astype(q.dtype)
+        return out, outs[1]
+    return outs.reshape(B, Hq, dh).astype(q.dtype)

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from triton_distributed_tpu.language import primitives as dl
 from triton_distributed_tpu.kernels import common
+from triton_distributed_tpu.kernels import probes as _probes
 from triton_distributed_tpu.obs import comm_ledger as _ledger
 from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
@@ -47,11 +48,13 @@ from triton_distributed_tpu.runtime.mesh import get_default_mesh
 
 def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
                        acc_ref, tmp_ref, out_vmem, *, axis: str, world: int,
-                       br: int):
+                       br: int, probe=_probes.NULL):
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
+    probe.enter(0, me, world)
 
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     # Push chunk x[peer] into peer's staging slot for source ``me``.
     sends = []
@@ -60,21 +63,22 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
         dma = common.remote_copy(
             x_ref.at[pl.ds(peer * m, m)],
             staging.at[common.peer_slot(me, peer)],
-            send_sems.at[i], recv_sems.at[me], axis, peer)
+            send_sems.at[i], recv_sems.at[me], axis, peer, probe=probe)
         sends.append(dma)
 
     for src in range(world):
         @pl.when(src != me)
         def _wait(src=src):
             common.wait_recv(staging.at[common.peer_slot(src, me)],
-                             recv_sems.at[src])
+                             recv_sems.at[src], probe=probe)
 
     # Fixed global reduce order 0..world-1 (own chunk read straight from
     # x_ref): deterministic, rank-independent bits (ADVICE r1); row-tiled.
     common.reduce_slots_tiled(
         x_ref, me * m, staging, world, me, o_ref, m=m, br=br, acc_ref=acc_ref,
-        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem)
+        tmp_ref=tmp_ref, out_ref=out_vmem, copy_sem=copy_sem, probe=probe)
     for dma in sends:
+        probe.dma_wait(o_ref)
         dma.wait_send()
 
 
@@ -85,37 +89,42 @@ def _oneshot_rs_kernel(x_ref, o_ref, staging, send_sems, recv_sems, copy_sem,
 
 def _ring_rs_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
                     copy_sem, acc_ref, tmp_ref, out_vmem, *, axis: str,
-                    world: int, br: int):
+                    world: int, br: int, probe=_probes.NULL):
     me = jax.lax.axis_index(axis)
     m = o_ref.shape[0]
     right = jax.lax.rem(me + 1, world)
+    probe.enter(0, me, world)
 
     dl.barrier_all(axis)
+    probe.sem_spin(world - 1)
 
     def reduce_chunk(x_off, stage_idx, dst_ref, dst_off):
         common.reduce_rows_tiled(
             x_ref, x_off, staging, stage_idx, dst_ref, dst_off, m=m, br=br,
             acc_ref=acc_ref, tmp_ref=tmp_ref, out_ref=out_vmem,
-            copy_sem=copy_sem)
+            copy_sem=copy_sem, probe=probe)
 
     for s in range(world - 1):
         c = jax.lax.rem(me - s - 1 + world, world)  # chunk forwarded at step s
         if s > 0:
             # Partial sum of chunk c from the left (arrived at step s-1).
-            common.wait_recv(staging.at[s - 1], recv_sems.at[s - 1])
+            common.wait_recv(staging.at[s - 1], recv_sems.at[s - 1],
+                             probe=probe)
         reduce_chunk(c * m, s - 1 if s > 0 else None, send_hbm, 0)
         dma = common.remote_copy(
             send_hbm, staging.at[s],
-            send_sems.at[s], recv_sems.at[s], axis, right)
+            send_sems.at[s], recv_sems.at[s], axis, right, probe=probe)
         # send_hbm is rewritten next step: wait local drain now. The ring is
         # latency-bound by the recv dependency anyway (pipelining across
         # sub-chunks is the further optimization, as in the reference's
         # ring CE variants).
+        probe.dma_wait(send_hbm)
         dma.wait_send()
 
     # Final arrival completes own segment: sum over all other ranks of chunk
     # ``me``, plus our own contribution.
-    common.wait_recv(staging.at[world - 2], recv_sems.at[world - 2])
+    common.wait_recv(staging.at[world - 2], recv_sems.at[world - 2],
+                     probe=probe)
     reduce_chunk(me * m, world - 2, o_ref, 0)
 
 
@@ -125,10 +134,10 @@ def _ring_rs_kernel(x_ref, o_ref, staging, send_hbm, send_sems, recv_sems,
 
 
 def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
-             n_staging_key: str):
+             n_staging_key: str, probes: bool = False):
     world = _axis_size(axis)
     if world == 1:
-        return x_local
+        return (x_local, _probes.host_stub_buffer()) if probes else x_local
     if x_local.shape[0] % world:
         raise ValueError(f"leading dim {x_local.shape[0]} not divisible by world {world}")
     m = x_local.shape[0] // world
@@ -151,30 +160,53 @@ def _rs_call(kernel, x_local, *, axis: str, interpret, collective_id: int,
         pltpu.VMEM((br, *rest), x_local.dtype),            # copy-in tile
         pltpu.VMEM((br, *rest), x_local.dtype),            # cast-out tile
     ]
-    return common.make_pallas_call(
-        functools.partial(kernel, axis=axis, world=world, br=br),
+    body = functools.partial(kernel, axis=axis, world=world, br=br)
+    out_specs = [common.hbm_spec()] * len(out_shape)
+    if probes:
+        n_base_out = len(out_shape)
+
+        def body(*refs):
+            # probe buffer rides as the LAST output, ordinal as LAST scratch
+            ins, rest_refs = refs[:1], refs[1:]
+            outs = rest_refs[:n_base_out]
+            pbuf = rest_refs[n_base_out]
+            scratch_refs = rest_refs[n_base_out + 1:-1]
+            pord = rest_refs[-1]
+            kernel(*ins, *outs, *scratch_refs, axis=axis, world=world, br=br,
+                   probe=_probes.Probe(pbuf, pord, n_steps=1))
+
+        out_shape = out_shape + [_probes.out_shape(1)]
+        out_specs = out_specs + [_probes.out_spec()]
+        scratch = scratch + [_probes.ord_scratch()]
+    outs = common.make_pallas_call(
+        body,
         out_shape=out_shape,
         in_specs=[common.any_spec()],
-        out_specs=[common.hbm_spec()] * len(out_shape),
+        out_specs=out_specs,
         scratch_shapes=scratch,
         collective_id=collective_id,
         interpret=interpret,
-    )(x_local)[0]
+    )(x_local)
+    return (outs[0], outs[-1]) if probes else outs[0]
 
 
-def oneshot_reduce_scatter(x_local, *, axis: str = "tp", interpret=None):
+def oneshot_reduce_scatter(x_local, *, axis: str = "tp", interpret=None,
+                           probes: bool = False):
     """Scatter+local-reduce RS of ``x_local (world*m, ...)`` → ``(m, ...)``:
-    returns sum over ranks of segment ``me``."""
+    returns sum over ranks of segment ``me``. ``probes=True`` builds the
+    instrumented variant and returns ``(out, probe_buf)``."""
     return _rs_call(_oneshot_rs_kernel, x_local, axis=axis, interpret=interpret,
                     collective_id=common.collective_id_for("rs_oneshot"),
-                    n_staging_key="oneshot")
+                    n_staging_key="oneshot", probes=probes)
 
 
-def ring_reduce_scatter(x_local, *, axis: str = "tp", interpret=None):
-    """Bandwidth-optimal ring RS (see module docstring)."""
+def ring_reduce_scatter(x_local, *, axis: str = "tp", interpret=None,
+                        probes: bool = False):
+    """Bandwidth-optimal ring RS (see module docstring); ``probes=True`` →
+    ``(out, probe_buf)``."""
     return _rs_call(_ring_rs_kernel, x_local, axis=axis, interpret=interpret,
                     collective_id=common.collective_id_for("rs_ring"),
-                    n_staging_key="ring")
+                    n_staging_key="ring", probes=probes)
 
 
 # ---------------------------------------------------------------------------

@@ -24,7 +24,7 @@ Schema (``as_dict()`` keys — the flat contract bench.py and
 scripts/serve_smoke.py consume):
   counters   ``<name>``                               -> float
   gauges     ``<name>``                               -> float
-  histograms ``<name>_{count,mean,p50,p95,max}``      -> float
+  histograms ``<name>_{count,mean,p50,p95,p99,max}``  -> float
   labeled series append ``{k=v,...}`` to ``<name>`` (sorted by key), e.g.
   ``bytes{collective=all_gather}`` or ``lat_s{axis=tp}_p50``.
 """
@@ -156,6 +156,7 @@ class Metrics:
             put(f"{name}_mean", h.mean, f"histogram {name!r}")
             put(f"{name}_p50", h.percentile(50), f"histogram {name!r}")
             put(f"{name}_p95", h.percentile(95), f"histogram {name!r}")
+            put(f"{name}_p99", h.percentile(99), f"histogram {name!r}")
             put(f"{name}_max", max(h.samples) if h.samples else 0.0,
                 f"histogram {name!r}")
         return out
@@ -193,6 +194,7 @@ class Metrics:
             out[f"{name}_mean"] = new.mean
             out[f"{name}_p50"] = new.percentile(50)
             out[f"{name}_p95"] = new.percentile(95)
+            out[f"{name}_p99"] = new.percentile(99)
             out[f"{name}_max"] = max(new.samples)
         return out
 
@@ -200,7 +202,7 @@ class Metrics:
 
     def to_prometheus(self) -> str:
         """Text exposition (format 0.0.4): counters as ``<name>_total``,
-        gauges verbatim, histograms as summaries (p50/p95 quantile series
+        gauges verbatim, histograms as summaries (p50/p95/p99 quantile series
         plus ``_sum``/``_count``). Invalid name characters sanitize to
         ``_``; labels carry through."""
         lines: list[str] = []
@@ -225,7 +227,7 @@ class Metrics:
             name, labels = _split_series(key)
             pname = _prom_name(name)
             header(pname, "summary")
-            for q, p in (("0.5", 50), ("0.95", 95)):
+            for q, p in (("0.5", 50), ("0.95", 95), ("0.99", 99)):
                 lines.append(
                     f"{pname}{_prom_labels(labels, {'quantile': q})} "
                     f"{h.percentile(p)!r}")

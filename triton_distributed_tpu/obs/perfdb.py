@@ -227,8 +227,9 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 # Checked BEFORE the higher-better hints: names the generic hints would
 # misread. "bytes_ratio" (bench --paged-attn: fused/gather HBM traffic)
 # contains "ratio" but fewer bytes win — without the override the gate
-# would wave a traffic regression through as an improvement.
-_LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac")
+# would wave a traffic regression through as an improvement. Same for
+# "overhead_frac" (bench --probe-overhead: telemetry cost vs plain build).
+_LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps")

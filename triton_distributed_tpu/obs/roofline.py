@@ -91,6 +91,23 @@ def collective_bound(collective: str, *, nbytes: float, world: int,
     return "hbm", hbm_s
 
 
+def split_hbm_bound(bound: str, stall_summary: dict | None, *,
+                    stall_threshold: float = 25.0) -> str:
+    """Refine a roofline class with device-probe stall attribution
+    (``obs.kprobe.stall_summary``): an "hbm"-classified site whose probes
+    show at least ``stall_threshold`` percent of modeled kernel time in
+    ``dma_wait`` + ``sem_spin`` is reported ``"hbm-stalled"`` (the kernel
+    was *waiting* on DMAs/semaphores), otherwise ``"hbm-bound"`` (it was
+    actually saturating the pipe). Non-hbm classes and missing summaries
+    pass through unchanged — the split only ever refines, never reclassifies.
+    """
+    if bound != "hbm" or not stall_summary:
+        return bound
+    stalled = (float(stall_summary.get("pct_dma_wait", 0.0))
+               + float(stall_summary.get("pct_sem_spin", 0.0)))
+    return "hbm-stalled" if stalled >= stall_threshold else "hbm-bound"
+
+
 def classify_step(*, flops: float, hbm_bytes: float, wall_s: float | None,
                   name: str = "step",
                   hw: pm.Hardware | None = None) -> RooflineRecord:

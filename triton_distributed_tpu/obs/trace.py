@@ -141,12 +141,27 @@ class Tracer:
 
     def chrome_events(self) -> list[dict]:
         """Ring buffer as Chrome trace-event dicts (ts/dur in microseconds,
-        pid = jax process index so merged multi-rank traces separate)."""
+        pid = jax process index so merged multi-rank traces separate).
+        Leads with ``M`` (metadata) events naming the process row
+        ``rank N`` and each host thread — merged multi-rank traces show
+        labeled rows, not bare pids."""
         try:
             pid = jax.process_index()
         except RuntimeError:
             pid = 0
-        events: list[dict] = []
+        events: list[dict] = [{
+            "name": "process_name", "ph": "M", "ts": 0, "pid": pid,
+            "args": {"name": f"rank {pid}"},
+        }]
+        named_tids: set[int] = set()
+        for r in self._records:
+            tid = r.tid % (1 << 31)
+            if tid not in named_tids:
+                named_tids.add(tid)
+                events.append({
+                    "name": "thread_name", "ph": "M", "ts": 0, "pid": pid,
+                    "tid": tid, "args": {"name": f"host thread {tid}"},
+                })
         for r in self._records:
             ev: dict[str, Any] = {
                 "name": r.name,
