@@ -247,6 +247,16 @@ def _record_perfdb(result: dict, path: str | None, *,
         if "metric" in result and "value" in result:
             flat[str(result["metric"])] = result["value"]
         flat.update(result.get("extras", {}))
+        # Autotune-search shrinkage: configs the resource analyzer pruned
+        # before timing this process (0 when no tuner ran a pruner).
+        try:
+            from triton_distributed_tpu.runtime.autotuner import (
+                pruned_configs_total,
+            )
+
+            flat.setdefault("pruned_configs", float(pruned_configs_total()))
+        except Exception:
+            pass
         fp = fingerprint(backend=("cpu-fallback"
                                   if result.get("backend") == "cpu-fallback"
                                   else None))

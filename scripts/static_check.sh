@@ -8,7 +8,12 @@
 #                                      deadlock-freedom) + the AST pass
 #                                      (discarded DMA handles, Python-int
 #                                      rank escapes). docs/analysis.md.
-#   2. tools/check_no_bare_print.py -> no bare print() in package or tools
+#   2. tools/resource_check.py      -> static VMEM/SMEM budgets, Mosaic
+#                                      tile legality, out-of-bounds
+#                                      bboxes, and grid-coverage for every
+#                                      registered kernel (incl. the
+#                                      '+probe' variants) at world 2/4/8.
+#   3. tools/check_no_bare_print.py -> no bare print() in package or tools
 #                                      code (dist_print only).
 #
 # Usage: bash scripts/static_check.sh [--tier1]
@@ -51,6 +56,13 @@ assert not bad, bad
 print(f"{len(probes.PROBE_BASES)} probe variants registered and clean "
       "at world 2/4/8.")
 EOF
+
+echo
+echo "== resource & layout analyzer (tools/resource_check.py) =="
+# Static VMEM/SMEM footprints vs the chip model, tile legality, OOB
+# bboxes, grid coverage — over every registered kernel (the registry sweep
+# already includes the '+probe' variants) at world 2/4/8.
+python -m tools.resource_check --world 2 --world 4 --world 8 || rc=1
 
 echo
 echo "== bare-print lint (tools/check_no_bare_print.py) =="

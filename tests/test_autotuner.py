@@ -15,8 +15,10 @@ from triton_distributed_tpu.runtime import autotuner
 def isolated_cache(tmp_path, monkeypatch):
     monkeypatch.setenv("TDT_AUTOTUNE_CACHE", str(tmp_path / "tune.json"))
     autotuner.clear_cache()
+    autotuner._pruned_counts.clear()
     yield
     autotuner.clear_cache()
+    autotuner._pruned_counts.clear()
 
 
 def test_tuner_picks_fastest_and_caches(monkeypatch):
@@ -136,6 +138,100 @@ def test_vote_single_process():
     # All-inf vote: index is meaningless but the invalid flag is collective.
     assert autotuner._vote_across_processes(
         [float("inf"), float("inf")]) == (0, False)
+
+
+def test_pruner_rejected_config_is_never_compiled(monkeypatch):
+    """ISSUE 8 acceptance: tune() must never compile (never call make_thunk
+    for) a config the resource pruner rejects — the analyzer runs BEFORE
+    any build, and pruned counts land in the module accounting."""
+    monkeypatch.setattr(autotuner, "perf_thunk",
+                        lambda thunk, **kw: float(thunk()))
+
+    def pruner(cfg):
+        return ["vmem-budget finding"] if cfg >= 8.0 else []
+
+    compiled = []
+
+    def make_thunk(cfg):
+        compiled.append(cfg)
+        return lambda: cfg
+
+    tuner = autotuner.ContextualAutotuner("pr", [8.0, 2.0, 16.0, 4.0],
+                                          pruner=pruner)
+    assert tuner.tune(make_thunk, "k") == 2.0
+    assert compiled == [2.0, 4.0]  # 8.0 and 16.0 pruned pre-compile
+    assert autotuner.pruned_counts()["pr"] == 2
+    assert autotuner.pruned_configs_total() >= 2
+    m = autotuner.metrics().as_dict()
+    assert m["autotune_pruned_configs{tuner=pr}"] >= 2.0
+
+    # Multi-timer path: pruned entries arrive as None thunks (never built).
+    seen = []
+
+    def fake_multi(thunks):
+        seen.append([t is None for t in thunks])
+        return [float("inf") if t is None else t() for t in thunks]
+
+    compiled.clear()
+    tuner2 = autotuner.ContextualAutotuner("pr2", [8.0, 2.0],
+                                           multi_timer=fake_multi,
+                                           pruner=pruner)
+    assert tuner2.tune(make_thunk, "k") == 2.0
+    assert compiled == [2.0] and seen == [[True, False]]
+
+
+def test_pruner_rejecting_everything_is_distrusted(monkeypatch):
+    """An analyzer that rejects every candidate is wrong, not the configs:
+    the tuner warns, ignores it, and times everything."""
+    monkeypatch.setattr(autotuner, "perf_thunk",
+                        lambda thunk, **kw: float(thunk()))
+    compiled = []
+
+    def make_thunk(cfg):
+        compiled.append(cfg)
+        return lambda: cfg
+
+    tuner = autotuner.ContextualAutotuner(
+        "prall", [3.0, 1.0], pruner=lambda cfg: ["always rejected"])
+    with pytest.warns(UserWarning, match="rejected all"):
+        assert tuner.tune(make_thunk, "k") == 1.0
+    assert compiled == [3.0, 1.0]
+    assert autotuner.pruned_counts().get("prall", 0) == 0
+
+    # A pruner that RAISES never prunes (analyzer bugs degrade to timing).
+    def broken(cfg):
+        raise RuntimeError("analyzer bug")
+
+    compiled.clear()
+    tuner2 = autotuner.ContextualAutotuner("prbug", [3.0, 1.0],
+                                           pruner=broken)
+    assert tuner2.tune(make_thunk, "k") == 1.0
+    assert compiled == [3.0, 1.0]
+
+
+def test_cache_key_separates_hardware_kinds_and_jax_version(monkeypatch):
+    """Satellite: the disk-cache key embeds the device kind and jax
+    version, so a winner tuned on one chip generation can never be served
+    to another (the disk cache file outlives both)."""
+    import jax
+
+    tuner = autotuner.ContextualAutotuner("hw", [1, 2])
+    monkeypatch.setattr(autotuner, "_device_kind", lambda: "TPU v5e")
+    k5 = tuner._key("ctx")
+    monkeypatch.setattr(autotuner, "_device_kind", lambda: "TPU v6e")
+    k6 = tuner._key("ctx")
+    assert k5 != k6
+    assert "TPU v5e" in k5 and "TPU v6e" in k6
+    assert f"jax{jax.__version__}" in k5
+
+    # A winner cached under one kind is invisible under the other.
+    monkeypatch.setattr(autotuner, "perf_thunk",
+                        lambda thunk, **kw: float(thunk()))
+    monkeypatch.setattr(autotuner, "_device_kind", lambda: "TPU v5e")
+    assert tuner.tune(lambda c: (lambda: float(c)), "ctx") == 1
+    assert tuner.peek("ctx") == 1
+    monkeypatch.setattr(autotuner, "_device_kind", lambda: "TPU v6e")
+    assert tuner.peek("ctx") is None
 
 
 def test_tuned_matmul_blocks_small_cpu():

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""Resource & layout gate: statically budget the distributed kernels.
+
+For every registered kernel (``analysis/registry.py``) at each requested
+world size, computes the static per-grid-step VMEM/SMEM footprint from the
+declared trace-spec buffers (``analysis/resources.py``), checks it against
+the chip model (``runtime/perf_model.py`` — clamped to Mosaic's 16 MiB
+scoped-vmem window), checks Mosaic tile legality of every VMEM-resident
+buffer (``analysis/layout.py``), then traces the kernel through the SPMD
+interpreter to catch out-of-bounds accesses and grid-coverage gaps
+(declared-covered outputs with bytes no write or DMA arrival ever touches).
+Everything runs on CPU in seconds — no TPU needed.
+
+Prints a markdown report (stdout, optionally ``--report`` file) and exits
+
+    0   every check clean
+    1   at least one finding
+    2   usage error (unknown kernel/hardware, no world sizes, bad arguments)
+
+CI invocation (the exact line ``scripts/static_check.sh`` runs):
+
+    python -m tools.resource_check --world 2 --world 4 --world 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")  # before any jax import
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/resource_check.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.analysis import registry, resources  # noqa: E402
+from triton_distributed_tpu.runtime import perf_model  # noqa: E402
+
+
+def _out(line: str = "") -> None:
+    sys.stdout.write(line + "\n")
+
+
+def _err(line: str) -> None:
+    sys.stderr.write(line + "\n")
+
+
+def run_sweep(names: list[str], worlds: list[int],
+              hardware: "perf_model.Hardware | None" = None):
+    """[(kernel, world, Footprint|None, [Finding])] — one row per
+    (kernel, world) pair actually checked (a kernel registered for fewer
+    worlds skips the rest). Footprint is None when the spec won't build."""
+    rows = []
+    for name in names:
+        entry = registry.get(name)
+        for w in worlds:
+            if w not in entry.worlds:
+                continue
+            try:
+                fp = resources.footprint(entry.build(w), hardware)
+            except Exception:  # noqa: BLE001 — surfaced as a finding below
+                fp = None
+            rows.append((name, w, fp,
+                         resources.check_resources(entry, w,
+                                                   hardware=hardware)))
+    return rows
+
+
+def _mib(n: int) -> str:
+    return f"{n / 2**20:.2f}"
+
+
+def render_report(rows, worlds) -> str:
+    n_find = sum(len(fs) for _, _, _, fs in rows)
+    lines = [
+        "# Resource & layout report",
+        "",
+        f"worlds: {', '.join(map(str, worlds))} — "
+        f"{len(rows)} kernel/world check(s), "
+        f"**{n_find} finding(s)** total",
+        "",
+        "| kernel | world | vmem MiB | budget MiB | smem B | sems |"
+        " findings | verdict |",
+        "|---|---:|---:|---:|---:|---:|---:|---|",
+    ]
+    for name, w, fp, fs in rows:
+        verdict = "**FINDING**" if fs else "clean"
+        if fp is None:
+            lines.append(f"| `{name}` | {w} | - | - | - | - | {len(fs)} |"
+                         f" {verdict} |")
+            continue
+        lines.append(
+            f"| `{name}` | {w} | {_mib(fp.vmem_bytes)} |"
+            f" {_mib(fp.vmem_budget)} | {fp.smem_bytes} | {fp.sem_slots} |"
+            f" {len(fs)} | {verdict} |")
+    lines.append("")
+    detail = [str(f) for _, _, _, fs in rows for f in fs]
+    if detail:
+        lines += ["## Findings", ""]
+        lines += [f"- {d}" for d in detail]
+        lines.append("")
+        lines.append(f"**{n_find} finding(s)** — see details above.")
+    else:
+        lines.append("all resource & layout checks clean.")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__.splitlines()[0],
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--world", type=int, action="append", default=None,
+                    help="world size to check (repeatable; default 2 4 8)")
+    ap.add_argument("--kernel", action="append", default=None,
+                    help="check only this registered kernel (repeatable; "
+                         "hidden mutant.* entries must be named explicitly)")
+    ap.add_argument("--hardware", default=None,
+                    help="chip model to budget against, e.g. 'tpu v5e' "
+                         "(default: Mosaic's scoped-vmem window against the "
+                         "v5e profile)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered kernels and exit")
+    ap.add_argument("--report", default=None,
+                    help="also write the markdown report to this path")
+    args = ap.parse_args(argv)
+
+    if args.list:
+        for e in registry.all_kernels(include_hidden=True):
+            tag = "  [hidden]" if e.hidden else ""
+            _out(f"{e.name}  worlds={list(e.worlds)}  ({e.module}){tag}")
+        return 0
+
+    worlds = args.world or [2, 4, 8]
+    if any(w < 1 for w in worlds):
+        _err("resource_check: world sizes must be >= 1")
+        return 2
+
+    hardware = None
+    if args.hardware:
+        hardware = perf_model.match_hardware(args.hardware)
+        if hardware is None:
+            _err(f"resource_check: unknown hardware {args.hardware!r}")
+            return 2
+
+    if args.kernel:
+        try:
+            names = [registry.get(n).name for n in args.kernel]
+        except KeyError as e:
+            _err(f"resource_check: {e.args[0]}")
+            return 2
+    else:
+        names = [e.name for e in registry.all_kernels()]
+    if not names:
+        _err("resource_check: no kernels registered")
+        return 2
+
+    rows = run_sweep(names, worlds, hardware)
+    report = render_report(rows, worlds)
+    _out(report)
+    if args.report:
+        with open(args.report, "w", encoding="utf-8") as f:
+            f.write(report)
+    return 1 if any(fs for _, _, _, fs in rows) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

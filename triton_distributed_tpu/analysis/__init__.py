@@ -1,8 +1,12 @@
-"""Static comm-safety analyzer for the distributed Pallas kernels.
+"""Static analyzers for the distributed Pallas kernels — no TPU required.
 
-Verifies semaphore balance, DMA completion, buffer happens-before, and
-cross-rank deadlock-freedom by instrumented SPMD abstract interpretation —
-no TPU required. See docs/analysis.md and ``tools/comm_check.py``.
+* comm safety (``checks.py``): semaphore balance, DMA completion, buffer
+  happens-before, cross-rank deadlock-freedom, by instrumented SPMD
+  abstract interpretation. See docs/analysis.md + ``tools/comm_check.py``.
+* resources & layout (``resources.py``/``layout.py``): VMEM/SMEM footprint
+  vs. the chip model, dtype tile legality, out-of-bounds bboxes, grid×block
+  coverage; also the ``ContextualAutotuner``'s static config pruner. See
+  ``tools/resource_check.py``.
 """
 
 from triton_distributed_tpu.analysis import registry  # noqa: F401

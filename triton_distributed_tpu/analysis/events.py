@@ -113,6 +113,28 @@ class DmaRecord:
 
 
 @dataclasses.dataclass
+class OobRecord:
+    """An index expression that reaches past its buffer's declared extent.
+
+    numpy silently CLIPS out-of-range slices, so without this record the
+    trace would quietly read/write a smaller bbox than the kernel asked
+    for — exactly the class of bug Mosaic rejects at compile time on TPU.
+    The tracer records the violation and lets the clipped access proceed,
+    so one bad index does not abort the rest of the trace.
+    """
+
+    buf: str
+    rank: int
+    op: str                 # 'read' | 'write' | 'view'
+    index: str              # the offending index expression, formatted
+    shape: tuple[int, ...]  # shape of the view the index was applied to
+
+    def describe(self) -> str:
+        return (f"rank {self.rank}: {self.op} {self.buf}[{self.index}] "
+                f"past declared shape {self.shape}")
+
+
+@dataclasses.dataclass
 class TraceResult:
     world: int
     ranks: int
@@ -122,6 +144,8 @@ class TraceResult:
     # back data the kernel produced during the trace — e.g. the device-probe
     # buffers of the "+probe" variants (obs/kprobe.py decodes them).
     store: dict | None = None
+    # Out-of-bounds index expressions seen during the recorded round.
+    oob: list = dataclasses.field(default_factory=list)
 
 
 # ---------------------------------------------------------------------------
@@ -129,14 +153,17 @@ class TraceResult:
 # ---------------------------------------------------------------------------
 
 class Tracer:
-    def __init__(self, world: int, ranks: int, grid: tuple[int, ...]):
+    def __init__(self, world: int, ranks: int, grid: tuple[int, ...],
+                 axes: tuple[tuple[str, int], ...] | None = None):
         self.world = world
         self.ranks = ranks
         self.grid = tuple(grid)
         self.grid_point: tuple[int, ...] = (0,) * len(grid)
+        self.axes = tuple(axes) if axes else None
         self.store: dict[tuple[str, int], np.ndarray] = {}
         self.logs: list[list[Event]] = [[] for _ in range(ranks)]
         self.dmas: list[DmaRecord] = []
+        self.oob: list[OobRecord] = []
         self.rank = 0
         self.recording = False
         self._eid = 0
@@ -157,6 +184,52 @@ class Tracer:
         did = self._did
         self._did += 1
         return did
+
+    def note_oob(self, rec: OobRecord) -> None:
+        if self.recording:
+            self.oob.append(rec)
+
+    # -- named mesh axes (TraceSpec.axes) ----------------------------------
+    def _axis_stride(self, axis: str) -> tuple[int, int]:
+        """(size, row-major stride) of a declared axis; raises on unknown
+        names when a mesh is declared (a typo'd axis name is a kernel bug,
+        not something to silently flatten)."""
+        assert self.axes is not None
+        stride = 1
+        found = None
+        for name, size in reversed(self.axes):
+            if name == axis:
+                found = (size, stride)
+            stride *= size
+        if found is None:
+            raise CommTraceError(
+                f"axis {axis!r} not in declared mesh "
+                f"{tuple(n for n, _ in self.axes)}")
+        return found
+
+    def axis_coord(self, axis: str) -> int:
+        """This rank's coordinate along ``axis`` (rank if no mesh)."""
+        if self.axes is None:
+            return self.rank
+        size, stride = self._axis_stride(axis)
+        return (self.rank // stride) % size
+
+    def axis_size_of(self, axis) -> int:
+        if self.axes is None:
+            return self.world
+        return self._axis_stride(axis)[0]
+
+    def global_rank_with(self, axis, peer: int) -> int:
+        """Global rank of the device at coordinate ``peer`` along ``axis``,
+        keeping this rank's other coordinates — the tracer-side analog of
+        ``compat.mesh_device_id``."""
+        if self.axes is None:
+            return int(peer)
+        size, stride = self._axis_stride(axis)
+        if not 0 <= int(peer) < size:
+            raise CommTraceError(
+                f"peer {int(peer)} outside axis {axis!r} of size {size}")
+        return self.rank + (int(peer) - self.axis_coord(axis)) * stride
 
 
 # ---------------------------------------------------------------------------
@@ -180,6 +253,16 @@ def _normalize_index(idx) -> tuple:
         else:
             out.append(int(i))
     return tuple(out)
+
+
+def _fmt_index(nidx: tuple) -> str:
+    def one(i):
+        if isinstance(i, slice):
+            a = "" if i.start is None else i.start
+            b = "" if i.stop is None else i.stop
+            return f"{a}:{b}"
+        return str(i)
+    return ", ".join(one(i) for i in nidx)
 
 
 class FakeRef:
@@ -237,8 +320,30 @@ class FakeRef:
     def at(self):
         return _RefIndexer(self)
 
+    def _check_bounds(self, nidx: tuple, op: str) -> None:
+        """Record slices that reach past the view's extent. numpy CLIPS such
+        slices silently, so without this the trace under-reports the bbox
+        the kernel actually asked for (Mosaic would reject it on TPU)."""
+        if any(i is Ellipsis or i is None for i in nidx):
+            return  # rare in kernel code; the simple positional walk below
+                    # would misalign dims, so skip rather than mis-report
+        for i, dim in zip(nidx, self._view.shape):
+            bad = False
+            if isinstance(i, slice):
+                start = 0 if i.start is None else i.start
+                stop = dim if i.stop is None else i.stop
+                bad = start < 0 or stop > dim or start > stop
+            elif isinstance(i, int):
+                bad = not -dim <= i < dim
+            if bad:
+                self._tracer.note_oob(OobRecord(
+                    buf=self.name, rank=self.rank, op=op,
+                    index=_fmt_index(nidx), shape=tuple(self._view.shape)))
+                return
+
     def _sub(self, idx) -> "FakeRef":
         idx = _normalize_index(idx)
+        self._check_bounds(idx, "view")
         try:
             sub = self._view[idx]
         except Exception as e:  # noqa: BLE001 — re-raise with context
@@ -282,6 +387,7 @@ class FakeRef:
     # -- value access (recorded) -------------------------------------------
     def __getitem__(self, idx):
         nidx = _normalize_index(idx)
+        self._check_bounds(nidx, "read")
         val = self._view[nidx]
         sub = self._view[self._widen(nidx)]
         lo, hi = FakeRef(self._tracer, self.name, self.rank, self._root,
@@ -291,6 +397,7 @@ class FakeRef:
 
     def __setitem__(self, idx, value):
         nidx = _normalize_index(idx)
+        self._check_bounds(nidx, "write")
         sub = self._view[self._widen(nidx)]
         lo, hi = FakeRef(self._tracer, self.name, self.rank, self._root,
                          sub).bbox() if sub.size else (0, 0)
@@ -523,13 +630,13 @@ def patched_sync_surface(tracer: Tracer):
         # np.int32, not Python int: comparisons must yield np.bool_ so that
         # jnp idioms like ``~is_own`` are logical-not, not bitwise-not on a
         # Python bool (``~True == -2`` is truthy and inverts predication).
-        return np.int32(tracer.rank)
+        return np.int32(tracer.axis_coord(axis))
 
     def fake_axis_size(axis):
-        return tracer.world
+        return tracer.axis_size_of(axis)
 
     def fake_mesh_device_id(axis, peer):
-        return int(peer)
+        return tracer.global_rank_with(axis, int(peer))
 
     def fake_rem(a, b):
         return a % b
@@ -647,7 +754,16 @@ def trace_kernel(spec: "_registry.TraceSpec", world: int) -> TraceResult:
     """Run ``spec.body`` once per rank per grid point under the patched
     sync surface and return the per-rank event logs + DMA records."""
     ranks = spec.ranks if spec.ranks is not None else world
-    tracer = Tracer(world=world, ranks=ranks, grid=spec.grid)
+    axes = getattr(spec, "axes", None)
+    if axes:
+        n = 1
+        for _, size in axes:
+            n *= size
+        if n != ranks:
+            raise CommTraceError(
+                f"declared mesh {axes} covers {n} ranks; spec traces "
+                f"{ranks}")
+    tracer = Tracer(world=world, ranks=ranks, grid=spec.grid, axes=axes)
     for arg in spec.args:
         if isinstance(arg, _registry.Buf):
             for r in range(ranks):
@@ -673,4 +789,5 @@ def trace_kernel(spec: "_registry.TraceSpec", world: int) -> TraceResult:
                     tracer.grid_point = pt
                     spec.body(*refs, **dict(spec.kwargs))
     return TraceResult(world=world, ranks=ranks, logs=tracer.logs,
-                       dmas=tracer.dmas, store=tracer.store)
+                       dmas=tracer.dmas, store=tracer.store,
+                       oob=tracer.oob)

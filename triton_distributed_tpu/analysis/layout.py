@@ -1,0 +1,124 @@
+"""Tile-layout geometry for the static resource analyzer.
+
+Pure shape/dtype arithmetic — no jax, no tracing. ``resources.py`` turns
+these into typed findings; this module answers three questions:
+
+* what is the **minimal Mosaic tile** for a dtype? The last two dims of a
+  VMEM allocation are tiled (sublane, lane) = (8, 128) for 4-byte types,
+  (16, 128) for 2-byte, (32, 128) for 1-byte — packing narrower elements
+  needs proportionally more rows per 32-bit sublane register.
+* how many bytes does a VMEM allocation **really occupy** after tile
+  padding? Sub-tile dims are padded up (legal, just wasteful), which is
+  what makes a (2, 64) f32 scratch cost a full (8, 128) tile.
+* is a shape **tile-aligned**? A last/second-minor dim LARGER than the
+  minimal tile that is not a multiple of it forces Mosaic into strided
+  retiling (or an outright lowering error on older toolchains); dims at
+  or under the tile are merely padded and are NOT flagged — real kernels
+  legitimately use e.g. Hkv=2 sublane dims.
+
+Plus interval arithmetic over the event logs' byte bboxes, used for the
+grid×block coverage check (a ``covered=True`` output buffer must have its
+every byte written on every rank).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+LANE = 128
+# itemsize -> minimal second-minor (sublane) extent. 8-byte types never
+# appear in our kernels; treat them like 4-byte (conservative).
+_SUBLANE = {1: 32, 2: 16, 4: 8}
+
+
+def min_tile(dtype) -> tuple[int, int]:
+    """Minimal (sublane, lane) tile for ``dtype`` on the last two dims."""
+    itemsize = np.dtype(dtype).itemsize
+    return (_SUBLANE.get(itemsize, 8), LANE)
+
+
+def _ceil_to(x: int, m: int) -> int:
+    return -(-int(x) // m) * m
+
+
+def padded_nbytes(shape: tuple[int, ...], dtype) -> int:
+    """Bytes a VMEM allocation of ``shape`` occupies after tile padding.
+
+    1-D allocations are laid out along lanes (pad to 128 elements); 0-D
+    cost one element. Leading (non-tiled) dims multiply through."""
+    dt = np.dtype(dtype)
+    if not shape:
+        return dt.itemsize
+    dims = [int(d) for d in shape]
+    sub, lane = min_tile(dt)
+    if len(dims) == 1:
+        return _ceil_to(dims[0], lane) * dt.itemsize
+    dims[-1] = _ceil_to(dims[-1], lane)
+    dims[-2] = _ceil_to(dims[-2], sub)
+    n = 1
+    for d in dims:
+        n *= d
+    return n * dt.itemsize
+
+
+def tile_misalignment(shape: tuple[int, ...], dtype) -> str | None:
+    """None when the last-two-dims layout is clean, else a detail string.
+
+    Only dims strictly larger than the minimal tile are required to be
+    multiples of it (see module docstring)."""
+    if len(shape) < 2:
+        return None
+    sub, lane = min_tile(dtype)
+    for ax, tile, label in ((-1, lane, "lane"), (-2, sub, "sublane")):
+        d = int(shape[ax])
+        if d > tile and d % tile:
+            return (f"{label} dim {d} of {np.dtype(dtype).name} buffer is "
+                    f"larger than the minimal tile {tile} but not a "
+                    f"multiple of it (min tile {(sub, lane)} for this "
+                    "dtype)")
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Byte-interval arithmetic over event-log bboxes
+# ---------------------------------------------------------------------------
+
+def merge_intervals(ivs: list[tuple[int, int]]) -> list[tuple[int, int]]:
+    """Union of half-open byte ranges, sorted and coalesced."""
+    out: list[tuple[int, int]] = []
+    for lo, hi in sorted((int(a), int(b)) for a, b in ivs if b > a):
+        if out and lo <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def coverage_gaps(ivs: list[tuple[int, int]],
+                  nbytes: int) -> list[tuple[int, int]]:
+    """Byte ranges of [0, nbytes) NOT covered by the union of ``ivs``."""
+    gaps: list[tuple[int, int]] = []
+    pos = 0
+    for lo, hi in merge_intervals(ivs):
+        if lo > pos:
+            gaps.append((pos, lo))
+        pos = max(pos, hi)
+    if pos < nbytes:
+        gaps.append((pos, nbytes))
+    return gaps
+
+
+def write_extents(trace) -> dict[tuple[str, int], list[tuple[int, int]]]:
+    """All written byte ranges per (buffer, rank): direct ``write`` events
+    plus DMA destination ranges — remote puts land in the *target* rank's
+    instance without a write event in its log, so the DMA records are the
+    only source of truth for received bytes."""
+    ext: dict[tuple[str, int], list[tuple[int, int]]] = {}
+    for log in trace.logs:
+        for ev in log:
+            if ev.kind == "write" and ev.buf is not None:
+                ext.setdefault((ev.buf, ev.rank), []).append((ev.lo, ev.hi))
+    for dma in trace.dmas:
+        ext.setdefault((dma.dst_buf, dma.dst_rank), []).append(
+            (dma.dst_lo, dma.dst_hi))
+    return ext

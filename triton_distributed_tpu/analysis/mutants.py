@@ -17,6 +17,19 @@ Mutants:
   consumer waits the recv semaphore at source slot ``(src + 1) % world``
   instead of ``src``: the wait can never be fed (deadlock) and the staging
   read races the actual arrival.
+
+Resource mutants (comm-clean choreography, broken RESOURCE declarations —
+the ``analysis.resources`` checker must flag them; ``tools/comm_check.py``
+stays green on all three):
+
+* ``mutant.vmem_blowup_tile`` — a copy kernel staging the whole operand in
+  one (65536, 128) f32 VMEM scratch: 32 MiB against Mosaic's 16 MiB
+  scoped-vmem window (``vmem-budget``).
+* ``mutant.misaligned_bf16_tile`` — a bf16 VMEM accumulator whose last dim
+  is 192: not a multiple of the 128-lane tile, so Mosaic would shred every
+  access across two tiles (``tile-align``).
+* ``mutant.grid_undercoverage`` — a 2-step grid writing 8-row blocks into a
+  24-row covered output: rows [16, 24) are never written (``grid-coverage``).
 """
 
 from __future__ import annotations
@@ -142,6 +155,85 @@ def _build_ll_mutant(world: int) -> TraceSpec:
             Buf("staging_out", (1,)),
             Sem("send_sems", (world - 1,)),
             Sem("recv_sems", (2, world)),
+            Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Resource mutants: comm-clean choreography, broken resource declarations.
+# The comm-safety checker must stay green on these — only
+# ``analysis.resources.check_resources`` flags them.
+# ---------------------------------------------------------------------------
+
+
+def _vmem_blowup_copy_kernel(x_ref, o_ref, stage_ref, copy_sem, *,
+                             axis: str, world: int):
+    # Comm-clean local double-copy through a VMEM stage — the BUG is the
+    # stage's declared size: (65536, 128) f32 = 32 MiB of VMEM against
+    # Mosaic's 16 MiB scoped-vmem window.
+    del axis, world
+    m = x_ref.shape[0]
+    common.local_copy(x_ref, stage_ref.at[pl.ds(0, m)], copy_sem)
+    common.local_copy(stage_ref.at[pl.ds(0, m)], o_ref, copy_sem)
+
+
+def _misaligned_acc_kernel(x_ref, o_ref, acc_ref, copy_sem, *,
+                           axis: str, world: int):
+    # Comm-clean copy; the BUG is acc's declared bf16 shape (8, 192) —
+    # last dim neither <= nor a multiple of the 128-lane tile.
+    del axis, world, acc_ref
+    common.local_copy(x_ref, o_ref, copy_sem)
+
+
+def _grid_undercoverage_kernel(x_ref, o_ref, copy_sem, *,
+                               axis: str, world: int):
+    # One 8-row block per grid step — but the grid has 2 steps against a
+    # declared 24-row covered output: rows [16, 24) are never written.
+    del axis, world
+    step = pl.program_id(0)
+    common.local_copy(x_ref, o_ref.at[pl.ds(step * _M, _M)], copy_sem)
+
+
+@registry.register("mutant.vmem_blowup_tile", hidden=True)
+def _build_vmem_blowup_mutant(world: int) -> TraceSpec:
+    return TraceSpec(
+        body=_vmem_blowup_copy_kernel,
+        args=[
+            Buf("x", (_M, *_REST)),
+            Buf("o", (_M, *_REST)),
+            Buf("stage", (65536, 128), np.float32, space="vmem"),
+            Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+@registry.register("mutant.misaligned_bf16_tile", hidden=True)
+def _build_misaligned_mutant(world: int) -> TraceSpec:
+    import jax.numpy as jnp
+
+    return TraceSpec(
+        body=_misaligned_acc_kernel,
+        args=[
+            Buf("x", (_M, *_REST)),
+            Buf("o", (_M, *_REST)),
+            Buf("acc", (_M, 192), np.dtype(jnp.bfloat16), space="vmem"),
+            Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="tp", world=world),
+    )
+
+
+@registry.register("mutant.grid_undercoverage", hidden=True)
+def _build_undercoverage_mutant(world: int) -> TraceSpec:
+    return TraceSpec(
+        body=_grid_undercoverage_kernel,
+        grid=(2,),
+        args=[
+            Buf("x", (_M, *_REST)),
+            Buf("o", (3 * _M, *_REST), covered=True),
             Sem("copy_sem"),
         ],
         kwargs=dict(axis="tp", world=world),

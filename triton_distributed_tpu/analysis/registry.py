@@ -26,12 +26,25 @@ class Buf:
     care which): one private instance is allocated per rank.
 
     ``init(rank, world)`` returns the initial ndarray; default zeros.
+
+    ``space`` declares where the ref lives on the real chip — ``"hbm"``
+    (pallas ANY/HBM refs fed by manual DMA), ``"vmem"`` (BlockSpec /
+    scratch_shapes VMEM allocations), or ``"smem"`` (scalar/telemetry
+    refs). The resource analyzer (analysis/resources.py) sums per-space
+    footprints against the chip model; the comm-safety checks ignore it.
+
+    ``covered=True`` asserts the kernel fully writes this buffer (every
+    byte, on every rank) — the layout analyzer checks grid×block coverage
+    of such bufs from the event logs. Leave False for buffers whose write
+    extent is data-dependent (e.g. ep.a2a recv slots).
     """
 
     name: str
     shape: tuple[int, ...]
     dtype: Any = np.float32
     init: Callable[[int, int], np.ndarray] | None = None
+    space: str = "hbm"
+    covered: bool = False
 
     def make(self, rank: int, world: int) -> np.ndarray:
         if self.init is not None:
@@ -63,6 +76,13 @@ class TraceSpec:
     # Number of ranks to actually trace. None -> world. Loopback (single
     # chip) kernels simulate `world` slots on one rank and set ranks=1.
     ranks: int | None = None
+    # Named mesh axes as ((name, size), ...), MAJOR axis first; their sizes
+    # must multiply to `world`. When set, the tracer's fake axis_index /
+    # axis_size / mesh_device_id become axis-aware (rank = row-major ravel
+    # of the per-axis coordinates), so 2-D kernels like collective_2d's
+    # intra-slice rings trace with their real axis names. None -> the
+    # legacy single flat axis (every name maps to the full world).
+    axes: tuple[tuple[str, int], ...] | None = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -87,6 +107,8 @@ _KERNEL_MODULES = (
     "triton_distributed_tpu.kernels.gemm_reduce_scatter",
     "triton_distributed_tpu.kernels.moe_overlap",
     "triton_distributed_tpu.kernels.sp_attention",
+    "triton_distributed_tpu.kernels.collective_2d",
+    "triton_distributed_tpu.kernels.paged_attention",
     "triton_distributed_tpu.kernels.probes",
     "triton_distributed_tpu.analysis.mutants",
 )

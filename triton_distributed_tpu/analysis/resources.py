@@ -1,0 +1,219 @@
+"""Static per-kernel resource & layout checking against the chip model.
+
+Answers "is this kernel + config legal on this chip?" on CPU, the way
+``checks.py`` answers "is the choreography deadlock-free?". For a registered
+kernel entry (``analysis/registry.py``) at a world size, optionally under a
+candidate autotuner config (extra ``build(world, **config)`` kwargs), it:
+
+* sums the per-grid-step **VMEM footprint** of every ``space="vmem"`` buffer
+  (tile-padded — see ``layout.padded_nbytes``) and the **SMEM footprint** of
+  ``space="smem"`` buffers plus one sync-flag word per declared semaphore
+  slot, and checks them against the ``perf_model`` chip model
+  (``Hardware.vmem_bytes/smem_bytes``). The VMEM budget is additionally
+  clamped to Mosaic's scoped-vmem compiler limit
+  (``kernels.common.MOSAIC_VMEM_LIMIT``): the chip may have 128 MiB, but a
+  single kernel's window is what the compiler will actually grant.
+* checks **tile legality** of every VMEM buffer's last two dims against the
+  dtype's minimal tile ((8,128) f32 / (16,128) bf16 / (32,128) int8).
+* (with ``trace=True``) runs the abstract interpreter
+  (``events.trace_kernel``) and reports **out-of-bounds bboxes** (index
+  expressions numpy would silently clip) and **grid×block coverage** gaps
+  on buffers declared ``covered=True`` (every byte must be written on every
+  rank — a grid that under-covers its output shows up here).
+
+Findings are typed like ``checks.Violation``; ``tools/resource_check.py``
+is the CLI and ``runtime/autotuner.py`` consumes :func:`config_pruner` to
+skip infeasible configs before ever compiling them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable
+
+import numpy as np
+
+from triton_distributed_tpu.analysis import events, layout
+from triton_distributed_tpu.analysis import registry as _registry
+from triton_distributed_tpu.runtime import perf_model
+
+RESOURCE_CHECKS = ("vmem-budget", "smem-budget", "tile-align",
+                   "grid-coverage", "oob-bbox", "resource-trace-error")
+
+# One 32-bit sync-flag word per semaphore slot, billed to SMEM.
+SEM_SLOT_BYTES = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One statically-proven resource/layout problem (cf. checks.Violation)."""
+
+    check: str          # one of RESOURCE_CHECKS
+    kernel: str
+    world: int
+    detail: str
+    buf: str | None = None
+
+    def __str__(self) -> str:
+        where = f" buf={self.buf}" if self.buf else ""
+        return (f"[{self.check}] {self.kernel} @ world={self.world}{where}: "
+                f"{self.detail}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Footprint:
+    """Per-grid-step scratchpad bill of one kernel spec."""
+
+    vmem_bytes: int     # tile-padded sum of space="vmem" buffers
+    smem_bytes: int     # space="smem" buffers + semaphore sync flags
+    sem_slots: int      # declared semaphore slots (scalars count 1)
+    vmem_budget: int
+    smem_budget: int
+
+
+def _scoped_vmem_limit() -> int:
+    # Lazy: kernels.common pulls the full pallas import surface, which the
+    # registry deliberately avoids at module level.
+    from triton_distributed_tpu.kernels import common
+    return common.MOSAIC_VMEM_LIMIT
+
+
+def footprint(spec: "_registry.TraceSpec",
+              hardware: perf_model.Hardware | None = None) -> Footprint:
+    """Static scratchpad footprint of one built spec (no tracing)."""
+    hw = hardware or perf_model.detect_hardware()
+    vmem = smem = slots = 0
+    for arg in spec.args:
+        if isinstance(arg, _registry.Sem):
+            n = 1
+            for d in arg.shape:
+                n *= int(d)
+            slots += n
+            continue
+        space = getattr(arg, "space", "hbm")
+        if space == "vmem":
+            vmem += layout.padded_nbytes(arg.shape, arg.dtype)
+        elif space == "smem":
+            n = 1
+            for d in arg.shape:
+                n *= int(d)
+            smem += n * np.dtype(arg.dtype).itemsize
+    smem += slots * SEM_SLOT_BYTES
+    return Footprint(
+        vmem_bytes=int(vmem), smem_bytes=int(smem), sem_slots=int(slots),
+        vmem_budget=min(int(hw.vmem_bytes), _scoped_vmem_limit()),
+        smem_budget=int(hw.smem_bytes))
+
+
+def _build(entry: "_registry.KernelEntry", world: int,
+           config: dict[str, Any] | None):
+    if config:
+        return entry.build(world, **config)
+    return entry.build(world)
+
+
+def check_resources(entry: "_registry.KernelEntry", world: int,
+                    config: dict[str, Any] | None = None, *,
+                    hardware: perf_model.Hardware | None = None,
+                    trace: bool = True) -> list[Finding]:
+    """All resource/layout findings for one kernel entry at one world size,
+    optionally under an autotuner config (extra build kwargs). Empty list
+    == feasible. Never raises: build/trace failures become
+    ``resource-trace-error`` findings, mirroring checks.check_kernel."""
+    name = entry.name
+    try:
+        spec = _build(entry, world, config)
+    except Exception as e:  # noqa: BLE001 — a config the build rejects
+        return [Finding("resource-trace-error", name, world,
+                        f"build({world}, **{config or {}}) failed: "
+                        f"{type(e).__name__}: {e}")]
+
+    findings: list[Finding] = []
+    fp = footprint(spec, hardware)
+    if fp.vmem_bytes > fp.vmem_budget:
+        findings.append(Finding(
+            "vmem-budget", name, world,
+            f"VMEM footprint {fp.vmem_bytes / 2**20:.2f} MiB exceeds the "
+            f"{fp.vmem_budget / 2**20:.0f} MiB budget (chip VMEM clamped "
+            "to Mosaic's scoped-vmem window)"))
+    if fp.smem_bytes > fp.smem_budget:
+        findings.append(Finding(
+            "smem-budget", name, world,
+            f"SMEM footprint {fp.smem_bytes} B (incl. {fp.sem_slots} "
+            f"semaphore slots) exceeds the {fp.smem_budget} B budget"))
+    for arg in spec.args:
+        if (isinstance(arg, _registry.Buf)
+                and getattr(arg, "space", "hbm") == "vmem"):
+            detail = layout.tile_misalignment(arg.shape, arg.dtype)
+            if detail:
+                findings.append(Finding("tile-align", name, world,
+                                        detail, buf=arg.name))
+    if not trace:
+        return findings
+
+    try:
+        tr = events.trace_kernel(spec, world)
+    except Exception as e:  # noqa: BLE001 — comm_check owns trace health;
+        # here a failed trace only means we cannot run the dynamic checks
+        findings.append(Finding(
+            "resource-trace-error", name, world,
+            f"trace failed: {type(e).__name__}: {e}"))
+        return findings
+
+    seen: set[tuple[str, int, str]] = set()
+    for o in tr.oob:
+        key = (o.buf, o.rank, o.index)
+        if key in seen:  # one finding per distinct bad index expression
+            continue
+        seen.add(key)
+        findings.append(Finding("oob-bbox", name, world, o.describe(),
+                                buf=o.buf))
+
+    ext = layout.write_extents(tr)
+    for arg in spec.args:
+        if not (isinstance(arg, _registry.Buf) and arg.covered):
+            continue
+        for r in range(tr.ranks):
+            nbytes = int(tr.store[(arg.name, r)].nbytes)
+            gaps = layout.coverage_gaps(ext.get((arg.name, r), []), nbytes)
+            if gaps:
+                lo, hi = gaps[0]
+                findings.append(Finding(
+                    "grid-coverage", name, world,
+                    f"rank {r}: {sum(b - a for a, b in gaps)} of {nbytes} "
+                    f"bytes never written (first gap [{lo}, {hi})) — "
+                    "grid×block does not cover the declared ref shape",
+                    buf=arg.name))
+    return findings
+
+
+def check_kernel(name: str, world: int,
+                 config: dict[str, Any] | None = None, *,
+                 hardware: perf_model.Hardware | None = None,
+                 trace: bool = True) -> list[Finding]:
+    """Name-based convenience over :func:`check_resources`."""
+    return check_resources(_registry.get(name), world, config,
+                           hardware=hardware, trace=trace)
+
+
+def config_pruner(name: str, world: int,
+                  config_of: Callable[[Any], dict[str, Any]] | None = None,
+                  *, hardware: perf_model.Hardware | None = None,
+                  trace: bool = False) -> Callable[[Any], list[Finding]]:
+    """A ``pruner(cfg) -> findings`` closure for
+    ``ContextualAutotuner(pruner=...)``: a non-empty findings list rejects
+    the config before it is ever compiled or timed.
+
+    ``config_of`` maps the autotuner's opaque config value to the entry's
+    build kwargs (defaults to ``dict(cfg)``). ``trace=False`` keeps the
+    pruner to the pure static checks — footprint and tile legality are the
+    config-dependent ones, and tune() may evaluate the pruner under a
+    timing loop."""
+    entry = _registry.get(name)
+
+    def pruner(cfg: Any) -> list[Finding]:
+        kw = dict(cfg) if config_of is None else dict(config_of(cfg))
+        return check_resources(entry, world, kw, hardware=hardware,
+                               trace=trace)
+
+    return pruner

@@ -296,7 +296,7 @@ def _comm_spec_ring(world: int) -> "_comm.TraceSpec":
         body=_ring_ag_kernel,
         args=[
             _comm.Buf("x", (m, *rest)),
-            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("o", (world * m, *rest), covered=True),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),
@@ -312,7 +312,7 @@ def _comm_spec_a2a(world: int) -> "_comm.TraceSpec":
         body=_a2a_ag_kernel,
         args=[
             _comm.Buf("x", (m, *rest)),
-            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("o", (world * m, *rest), covered=True),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),

@@ -1030,13 +1030,13 @@ def _comm_spec_ag_gemm(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_ag_gemm_kernel,
         args=[
-            _comm.Buf("me", (1,), _np.int32,
+            _comm.Buf("me", (1,), _np.int32, space="smem",
                       init=lambda r, w: _np.array([r], _np.int32)),
             _comm.Buf("a", (m, k)),
             _comm.Buf("b", (k, bn)),
-            _comm.Buf("o", (m, bn)),
+            _comm.Buf("o", (m, bn), covered=True),
             _comm.Buf("a_full", (world, m, k)),
-            _comm.Buf("a_vmem", (2, m, k)),
+            _comm.Buf("a_vmem", (2, m, k), space="vmem"),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sems", (2,)),

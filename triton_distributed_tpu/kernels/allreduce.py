@@ -358,14 +358,14 @@ def _comm_spec_oneshot(world: int) -> "_comm.TraceSpec":
         body=_oneshot_ar_kernel,
         args=[
             _comm.Buf("x", (m, *rest)),
-            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("o", (m, *rest), covered=True),
             _comm.Buf("staging", (world - 1, m, *rest)),
             _comm.Sem("send_sems", (world,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("acc", (m, *rest)),
-            _comm.Buf("tmp", (m, *rest)),
-            _comm.Buf("out_vmem", (m, *rest)),
+            _comm.Buf("acc", (m, *rest), space="vmem"),
+            _comm.Buf("tmp", (m, *rest), space="vmem"),
+            _comm.Buf("out_vmem", (m, *rest), space="vmem"),
         ],
         kwargs=dict(axis="tp", world=world, br=m),
     )
@@ -379,13 +379,13 @@ def _comm_spec_oneshot_loopback(world: int) -> "_comm.TraceSpec":
         ranks=1,  # single-chip self-loopback: world slots on one rank
         args=[
             _comm.Buf("x", (m, *rest)),
-            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("o", (m, *rest), covered=True),
             _comm.Buf("staging", (world - 1, m, *rest)),
             _comm.Sem("seg_sems", (world - 1,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("acc", (m, *rest)),
-            _comm.Buf("tmp", (m, *rest)),
-            _comm.Buf("out_vmem", (m, *rest)),
+            _comm.Buf("acc", (m, *rest), space="vmem"),
+            _comm.Buf("tmp", (m, *rest), space="vmem"),
+            _comm.Buf("out_vmem", (m, *rest), space="vmem"),
         ],
         kwargs=dict(world=world, br=m),
     )
@@ -398,7 +398,7 @@ def _comm_spec_twoshot(world: int) -> "_comm.TraceSpec":
         body=_twoshot_ar_kernel,
         args=[
             _comm.Buf("x", (world * m, *rest)),
-            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("o", (world * m, *rest), covered=True),
             _comm.Buf("staging", (world - 1, m, *rest)),
             _comm.Buf("send_hbm", (m, *rest)),
             _comm.Sem("send_sems", (world - 1,)),
@@ -406,9 +406,9 @@ def _comm_spec_twoshot(world: int) -> "_comm.TraceSpec":
             _comm.Sem("ag_send_sems", (world - 1,)),
             _comm.Sem("ag_recv_sems", (world - 1,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("acc", (m, *rest)),
-            _comm.Buf("tmp", (m, *rest)),
-            _comm.Buf("out_vmem", (m, *rest)),
+            _comm.Buf("acc", (m, *rest), space="vmem"),
+            _comm.Buf("tmp", (m, *rest), space="vmem"),
+            _comm.Buf("out_vmem", (m, *rest), space="vmem"),
         ],
         kwargs=dict(axis="tp", world=world, br=m),
     )

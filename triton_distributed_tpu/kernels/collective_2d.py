@@ -255,3 +255,115 @@ def all_reduce_2d(x_stacked, *, mesh: Mesh | None = None,
         axis=f"{dcn_axis}x{ici_axis}", world=world,
         nbytes=pm.wire_bytes_all_reduce(nbytes, world, "two_shot"),
         method="ring_2d", est_s=est)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer registration (analysis/registry.py).
+#
+# The 2D collectives are compositions: an intra-slice Pallas ring leg (all
+# device-side semaphores/DMAs) and a DCN leg riding XLA collectives
+# (all_gather/psum/psum_scatter — no device-visible sync surface, so
+# nothing for the tracer to check). We trace the REAL ring kernel bodies
+# under the declared 2D mesh (TraceSpec.axes, dcn-major: global rank =
+# dcn_index * w_ici + ici_index), so the analyzer proves the documented
+# rank convention — every intra-slice DMA and barrier signal must resolve
+# to a global rank inside the issuing rank's slice, at every slice.
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu import analysis as _comm  # noqa: E402
+from triton_distributed_tpu.analysis import registry as _registry  # noqa: E402
+from triton_distributed_tpu.kernels.allgather import (  # noqa: E402
+    _ring_ag_kernel)
+from triton_distributed_tpu.kernels.reduce_scatter import (  # noqa: E402
+    _ring_rs_kernel)
+
+_2D_M, _2D_REST = 8, (128,)
+
+
+def _2d_mesh(world: int) -> tuple[int, int, tuple[tuple[str, int], ...]]:
+    w_dcn = 2
+    w_ici = world // w_dcn
+    return w_ici, w_dcn, (("dcn", w_dcn), ("ici", w_ici))
+
+
+@_comm.register("ag.ring_2d", worlds=(4, 8))
+def _comm_spec_ag_2d(world: int) -> "_registry.TraceSpec":
+    w_ici, _, axes = _2d_mesh(world)
+    m, rest = _2D_M, _2D_REST
+    return _registry.TraceSpec(
+        body=_ring_ag_kernel,
+        args=[
+            _registry.Buf("x", (m, *rest)),
+            _registry.Buf("o", (w_ici * m, *rest), covered=True),
+            _registry.Sem("send_sems", (w_ici - 1,)),
+            _registry.Sem("recv_sems", (w_ici,)),
+            _registry.Sem("copy_sem"),
+        ],
+        kwargs=dict(axis="ici", world=w_ici),
+        axes=axes,
+    )
+
+
+@_comm.register("rs.ring_2d", worlds=(4, 8))
+def _comm_spec_rs_2d(world: int) -> "_registry.TraceSpec":
+    w_ici, _, axes = _2d_mesh(world)
+    m, rest = _2D_M, _2D_REST
+    return _registry.TraceSpec(
+        body=_ring_rs_kernel,
+        args=[
+            _registry.Buf("x", (w_ici * m, *rest)),
+            _registry.Buf("o", (m, *rest), covered=True),
+            _registry.Buf("staging", (w_ici - 1, m, *rest)),
+            _registry.Buf("send_hbm", (m, *rest)),
+            _registry.Sem("send_sems", (w_ici - 1,)),
+            _registry.Sem("recv_sems", (w_ici - 1,)),
+            _registry.Sem("copy_sem"),
+            _registry.Buf("acc", (m, *rest), space="vmem"),
+            _registry.Buf("tmp", (m, *rest), space="vmem"),
+            _registry.Buf("out_vmem", (m, *rest), space="vmem"),
+        ],
+        kwargs=dict(axis="ici", world=w_ici, br=m),
+        axes=axes,
+    )
+
+
+def _ar_2d_trace_body(x_ref, rs_o, staging, send_hbm, rs_send, rs_recv,
+                      rs_copy, acc, tmp, out_vmem, o_ref, ag_send, ag_recv,
+                      ag_copy, *, world: int, br: int):
+    """The device-side sequence of all_reduce_2d_device: intra-slice ring
+    RS, (XLA psum over DCN — not device-visible, elided), intra-slice ring
+    AG of the reduced chunk. Two separate kernels in production; traced
+    back-to-back here so the analyzer also proves the second leg's
+    semaphores cannot interfere with the first's."""
+    _ring_rs_kernel(x_ref, rs_o, staging, send_hbm, rs_send, rs_recv,
+                    rs_copy, acc, tmp, out_vmem, axis="ici", world=world,
+                    br=br)
+    _ring_ag_kernel(rs_o, o_ref, ag_send, ag_recv, ag_copy, axis="ici",
+                    world=world)
+
+
+@_comm.register("ar.ring_2d", worlds=(4, 8))
+def _comm_spec_ar_2d(world: int) -> "_registry.TraceSpec":
+    w_ici, _, axes = _2d_mesh(world)
+    m, rest = _2D_M, _2D_REST
+    return _registry.TraceSpec(
+        body=_ar_2d_trace_body,
+        args=[
+            _registry.Buf("x", (w_ici * m, *rest)),
+            _registry.Buf("rs_o", (m, *rest), covered=True),
+            _registry.Buf("staging", (w_ici - 1, m, *rest)),
+            _registry.Buf("send_hbm", (m, *rest)),
+            _registry.Sem("send_sems", (w_ici - 1,)),
+            _registry.Sem("recv_sems", (w_ici - 1,)),
+            _registry.Sem("copy_sem"),
+            _registry.Buf("acc", (m, *rest), space="vmem"),
+            _registry.Buf("tmp", (m, *rest), space="vmem"),
+            _registry.Buf("out_vmem", (m, *rest), space="vmem"),
+            _registry.Buf("o", (w_ici * m, *rest), covered=True),
+            _registry.Sem("ag_send_sems", (w_ici - 1,)),
+            _registry.Sem("ag_recv_sems", (w_ici,)),
+            _registry.Sem("ag_copy_sem"),
+        ],
+        kwargs=dict(world=w_ici, br=m),
+        axes=axes,
+    )

@@ -570,7 +570,7 @@ def _comm_spec_a2a_ep(world: int) -> "_comm.TraceSpec":
             _comm.Sem("pay_sems", (2 * world - 1,)),
             _comm.Sem("cnt_sems", (2 * world - 1,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("rcnt_smem", (8, 128), _np.int32),
+            _comm.Buf("rcnt_smem", (8, 128), _np.int32, space="smem"),
         ],
         kwargs=dict(axis="ep", world=world, n_payloads=1,
                     n_chunks=_COMM_CAP // _COMM_CH, ch=_COMM_CH),
@@ -586,7 +586,7 @@ def _comm_spec_a2a_loopback(world: int) -> "_comm.TraceSpec":
             _comm.Sem("pay_sems", (world,)),
             _comm.Sem("cnt_sems", (world,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("rcnt_smem", (8, 128), _np.int32),
+            _comm.Buf("rcnt_smem", (8, 128), _np.int32, space="smem"),
         ],
         kwargs=dict(world=world, n_payloads=1,
                     n_chunks=_COMM_CAP // _COMM_CH, ch=_COMM_CH),

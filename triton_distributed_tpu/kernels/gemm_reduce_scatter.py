@@ -505,17 +505,17 @@ def _comm_spec_gemm_rs(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_gemm_rs_kernel,
         args=[
-            _comm.Buf("me", (1,), _np.int32,
+            _comm.Buf("me", (1,), _np.int32, space="smem",
                       init=lambda r, w: _np.array([r], _np.int32)),
             _comm.Buf("a", (world * m, k)),
             _comm.Buf("b", (k, bn)),
-            _comm.Buf("o", (m, n)),
+            _comm.Buf("o", (m, n), covered=True),
             _comm.Buf("staging", (world - 1, m, n)),
-            _comm.Buf("a_vmem", (m, k)),
-            _comm.Buf("send_tile", (2, m, bn)),
-            _comm.Buf("acc_tile", (m, bn)),
-            _comm.Buf("tmp_tile", (m, bn)),
-            _comm.Buf("out_tile", (m, bn)),
+            _comm.Buf("a_vmem", (m, k), space="vmem"),
+            _comm.Buf("send_tile", (2, m, bn), space="vmem"),
+            _comm.Buf("acc_tile", (m, bn), space="vmem"),
+            _comm.Buf("tmp_tile", (m, bn), space="vmem"),
+            _comm.Buf("out_tile", (m, bn), space="vmem"),
             _comm.Sem("send_sems", (2,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),

@@ -231,10 +231,10 @@ def _comm_spec_ll(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_ll_ag_kernel,
         args=[
-            _comm.Buf("p", (1,), _np.int32),
+            _comm.Buf("p", (1,), _np.int32, space="smem"),
             _comm.Buf("x", (m, *rest)),
             _comm.Buf("staging", (2, world - 1, m, *rest)),
-            _comm.Buf("o", (world * m, *rest)),
+            _comm.Buf("o", (world * m, *rest), covered=True),
             _comm.Buf("staging_out", (1,)),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (2, world)),

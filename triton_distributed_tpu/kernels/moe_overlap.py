@@ -587,14 +587,14 @@ def _comm_spec_ag_group_gemm(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_ag_group_gemm_kernel,
         args=[
-            _comm.Buf("me", (1,), _np.int32,
+            _comm.Buf("me", (1,), _np.int32, space="smem",
                       init=lambda r, w: _np.array([r], _np.int32)),
             _comm.Buf("x", (n_e, cap, d)),
             _comm.Buf("w", (1, d, f)),
-            _comm.Buf("o", (1, cap, f)),
+            _comm.Buf("o", (1, cap, f), covered=True),
             _comm.Buf("a_full", (world - 1, n_e, cap, d)),
-            _comm.Buf("a_vmem", (cap, d)),
-            _comm.Buf("acc", (cap, f)),
+            _comm.Buf("a_vmem", (cap, d), space="vmem"),
+            _comm.Buf("acc", (cap, f), space="vmem"),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),
@@ -610,18 +610,18 @@ def _comm_spec_group_gemm_rs(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_group_gemm_rs_kernel,
         args=[
-            _comm.Buf("me", (1,), _np.int32,
+            _comm.Buf("me", (1,), _np.int32, space="smem",
                       init=lambda r, w: _np.array([r], _np.int32)),
             _comm.Buf("a", (n_e, world * cap, f)),
             _comm.Buf("w", (1, f, bd)),
-            _comm.Buf("o", (n_e, cap, bd)),
+            _comm.Buf("o", (n_e, cap, bd), covered=True),
             _comm.Buf("staging", (world - 1, n_e, cap, bd)),
-            _comm.Buf("a_vmem", (cap, f)),
-            _comm.Buf("send_tile", (2, cap, bd)),
-            _comm.Buf("part", (cap, bd)),
-            _comm.Buf("acc_tile", (cap, bd)),
-            _comm.Buf("tmp_tile", (cap, bd)),
-            _comm.Buf("out_tile", (cap, bd)),
+            _comm.Buf("a_vmem", (cap, f), space="vmem"),
+            _comm.Buf("send_tile", (2, cap, bd), space="vmem"),
+            _comm.Buf("part", (cap, bd), space="vmem"),
+            _comm.Buf("acc_tile", (cap, bd), space="vmem"),
+            _comm.Buf("tmp_tile", (cap, bd), space="vmem"),
+            _comm.Buf("out_tile", (cap, bd), space="vmem"),
             _comm.Sem("send_sems", (2,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),

@@ -101,8 +101,24 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
                             itemsize)
     if len(cands) == 1:
         return cands[0]
+
+    def resource_pruner(tile):
+        # Static VMEM/layout feasibility of one candidate tile, evaluated
+        # against the registered "paged.decode" trace spec at the live
+        # geometry — any finding rejects the tile before the tuner ever
+        # compiles it. Lazy import: the analysis layer must stay optional
+        # on the serving hot path.
+        from triton_distributed_tpu.analysis import resources as _res
+
+        return _res.check_kernel(
+            "paged.decode", 1,
+            dict(tile_blocks=int(tile), bs=block_size, n_kv=n_kv_heads,
+                 dh=head_dim, max_blocks=max_blocks, dtype=dtype_str),
+            trace=False)
+
     tuner = ContextualAutotuner("paged_attn_tile", cands,
-                                multi_timer=interleaved_slope_timer)
+                                multi_timer=interleaved_slope_timer,
+                                pruner=resource_pruner)
     ctx = f"bs{block_size}:h{n_kv_heads}:d{head_dim}:mb{max_blocks}:{dtype_str}"
 
     if not on_tpu() or not _trace_state_clean():
@@ -378,3 +394,78 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
         out = outs[0].reshape(B, Hq, dh).astype(q.dtype)
         return out, outs[1]
     return outs.reshape(B, Hq, dh).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Analyzer registration (analysis/registry.py).
+#
+# Single-device kernel (ranks=1; the sweep's world sizes are slot counts
+# elsewhere and ignored here, like ar.oneshot_loopback). The build accepts
+# the autotuner's config as kwargs — ``tile_blocks`` plus the live pool
+# geometry — which is what lets ``analysis.resources.check_resources``
+# evaluate a candidate config's VMEM staging footprint, tile legality, and
+# grid×block coverage of the output BEFORE the tuner ever compiles it
+# (``tuned_paged_tile`` wires it in as the ContextualAutotuner pruner).
+# ---------------------------------------------------------------------------
+
+from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
+import numpy as _np  # noqa: E402
+
+
+def _paged_trace_body(tbl, kvlen, q, kp, vp, o, k_buf, v_buf, acc, m_run,
+                      l_run, sems, **kw):
+    # Apply the (1, Hkv, g, dh) q/o BlockSpec windows by hand — the tracer
+    # passes whole buffers, the real grid_spec passes per-slot blocks.
+    b = int(pl.program_id(0))
+    _paged_decode_kernel(tbl, kvlen, q.at[pl.ds(b, 1)], kp, vp,
+                         o.at[pl.ds(b, 1)], k_buf, v_buf, acc, m_run,
+                         l_run, sems, **kw)
+
+
+@_comm.register("paged.decode")
+def _comm_spec_paged(world: int, *, tile_blocks: int = 2, bs: int = 16,
+                     n_kv: int = 2, g: int = 2, dh: int = 128,
+                     max_blocks: int = 4,
+                     dtype: str = "float32") -> "_comm.TraceSpec":
+    B = 2
+    dt = _np.dtype(jnp.dtype(dtype))
+    n_blocks = B * max_blocks
+    n_tiles = -(-max_blocks // tile_blocks)
+    tbl_w = n_tiles * tile_blocks     # host-side right padding, never read
+
+    def tables(r, w):
+        t = _np.zeros((B, tbl_w), _np.int32)
+        t[:, :max_blocks] = _np.arange(n_blocks, dtype=_np.int32).reshape(
+            B, max_blocks)
+        return t
+
+    return _comm.TraceSpec(
+        body=_paged_trace_body,
+        ranks=1,
+        grid=(B, n_tiles),
+        args=[
+            _comm.Buf("tbl", (B, tbl_w), _np.int32, space="smem",
+                      init=tables),
+            _comm.Buf("kvlen", (B,), _np.int32, space="smem",
+                      init=lambda r, w: _np.full((B,), max_blocks * bs,
+                                                 _np.int32)),
+            _comm.Buf("q", (B, n_kv, g, dh), dt),
+            _comm.Buf("kp", (n_blocks, bs, n_kv, dh), dt),
+            _comm.Buf("vp", (n_blocks, bs, n_kv, dh), dt),
+            # One (1, Hkv, g, dh) window of q and o is VMEM-resident per
+            # grid step; billing the full B=2 buffers stays within a few
+            # KiB of that and keeps the declaration honest.
+            _comm.Buf("o", (B, n_kv, g, dh), _np.float32, space="vmem",
+                      covered=True),
+            _comm.Buf("k_buf", (tile_blocks * bs, n_kv, dh), dt,
+                      space="vmem"),
+            _comm.Buf("v_buf", (tile_blocks * bs, n_kv, dh), dt,
+                      space="vmem"),
+            _comm.Buf("acc", (n_kv, g, dh), _np.float32, space="vmem"),
+            _comm.Buf("m_run", (n_kv, g, 1), _np.float32, space="vmem"),
+            _comm.Buf("l_run", (n_kv, g, 1), _np.float32, space="vmem"),
+            _comm.Sem("sems", (2,)),
+        ],
+        kwargs=dict(n_tiles=n_tiles, tile_blocks=tile_blocks, bs=bs,
+                    n_blocks=n_blocks, scale=1.0, n_kv=n_kv),
+    )

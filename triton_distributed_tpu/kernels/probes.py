@@ -263,11 +263,12 @@ def _register_probe_variant(base_name: str) -> None:
             body=body,
             args=[*base.args,
                   _comm.Buf("probe_buf", (n_rows(n_steps), N_FIELDS),
-                            np.int32),
-                  _comm.Buf("probe_ord", (1,), np.int32)],
+                            np.int32, space="smem"),
+                  _comm.Buf("probe_ord", (1,), np.int32, space="smem")],
             grid=base.grid,
             kwargs=dict(base.kwargs),
             ranks=base.ranks,
+            axes=base.axes,
         )
 
 

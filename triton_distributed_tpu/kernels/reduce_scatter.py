@@ -305,14 +305,14 @@ def _comm_spec_oneshot_rs(world: int) -> "_comm.TraceSpec":
         body=_oneshot_rs_kernel,
         args=[
             _comm.Buf("x", (world * m, *rest)),
-            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("o", (m, *rest), covered=True),
             _comm.Buf("staging", (world - 1, m, *rest)),
             _comm.Sem("send_sems", (world,)),
             _comm.Sem("recv_sems", (world,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("acc", (m, *rest)),
-            _comm.Buf("tmp", (m, *rest)),
-            _comm.Buf("out_vmem", (m, *rest)),
+            _comm.Buf("acc", (m, *rest), space="vmem"),
+            _comm.Buf("tmp", (m, *rest), space="vmem"),
+            _comm.Buf("out_vmem", (m, *rest), space="vmem"),
         ],
         kwargs=dict(axis="tp", world=world, br=m),
     )
@@ -325,15 +325,15 @@ def _comm_spec_ring_rs(world: int) -> "_comm.TraceSpec":
         body=_ring_rs_kernel,
         args=[
             _comm.Buf("x", (world * m, *rest)),
-            _comm.Buf("o", (m, *rest)),
+            _comm.Buf("o", (m, *rest), covered=True),
             _comm.Buf("staging", (world - 1, m, *rest)),
             _comm.Buf("send_hbm", (m, *rest)),
             _comm.Sem("send_sems", (world - 1,)),
             _comm.Sem("recv_sems", (world - 1,)),
             _comm.Sem("copy_sem"),
-            _comm.Buf("acc", (m, *rest)),
-            _comm.Buf("tmp", (m, *rest)),
-            _comm.Buf("out_vmem", (m, *rest)),
+            _comm.Buf("acc", (m, *rest), space="vmem"),
+            _comm.Buf("tmp", (m, *rest), space="vmem"),
+            _comm.Buf("out_vmem", (m, *rest), space="vmem"),
         ],
         kwargs=dict(axis="tp", world=world, br=m),
     )

@@ -987,20 +987,20 @@ def _comm_spec_sp_ag_attn(world: int) -> "_comm.TraceSpec":
     return _comm.TraceSpec(
         body=_sp_attn_kernel,
         args=[
-            _comm.Buf("scalars", (3,), _np.int32,
+            _comm.Buf("scalars", (3,), _np.int32, space="smem",
                       init=lambda r, w: _np.array([r, r * 8, 0], _np.int32)),
             _comm.Buf("q", (H, m, dh)),
             _comm.Buf("k", (H, m_kv, dh)),
             _comm.Buf("v", (H, m_kv, dh)),
-            _comm.Buf("o", (1, m, dh)),
+            _comm.Buf("o", (1, m, dh), covered=True),
             _comm.Buf("k_full", (world, H, m_kv, dh)),
             _comm.Buf("v_full", (world, H, m_kv, dh)),
-            _comm.Buf("q_vmem", (m, dh)),
-            _comm.Buf("k_vmem", (m_kv, dh)),
-            _comm.Buf("v_vmem", (m_kv, dh)),
-            _comm.Buf("acc", (m, dh)),
-            _comm.Buf("m_run", (m, 1)),
-            _comm.Buf("l_run", (m, 1)),
+            _comm.Buf("q_vmem", (m, dh), space="vmem"),
+            _comm.Buf("k_vmem", (m_kv, dh), space="vmem"),
+            _comm.Buf("v_vmem", (m_kv, dh), space="vmem"),
+            _comm.Buf("acc", (m, dh), space="vmem"),
+            _comm.Buf("m_run", (m, 1), space="vmem"),
+            _comm.Buf("l_run", (m, 1), space="vmem"),
             _comm.Sem("send_sems", (2 * (world - 1),)),
             _comm.Sem("recv_sems", (2 * world,)),
             _comm.Sem("copy_sem"),

@@ -41,6 +41,57 @@ _DEFAULT_CACHE = os.path.join(
 
 _memory_cache: dict[str, Any] = {}
 
+# Per-tuner-name counts of configs statically rejected by the resource
+# analyzer (the ``pruner=`` hook) before any compile/timing. bench.py's
+# perfdb samples and serving's ``perfdb_sample()`` read these so
+# autotune-search shrinkage is visible in the run DB.
+_pruned_counts: dict[str, int] = {}
+
+# Lazily-built obs.metrics registry for the pruned-config counter
+# (``autotune_pruned_configs{tuner=<name>}``) — lazy so importing the
+# autotuner never drags in the obs layer.
+_metrics = None
+
+
+def metrics():
+    """The autotuner's obs.metrics.Metrics registry (created on first use)."""
+    global _metrics
+    if _metrics is None:
+        from triton_distributed_tpu.obs.metrics import Metrics
+
+        _metrics = Metrics()
+    return _metrics
+
+
+def pruned_counts() -> dict[str, int]:
+    """Copy of the per-tuner pruned-config counts since process start."""
+    return dict(_pruned_counts)
+
+
+def pruned_configs_total() -> int:
+    """Total configs statically pruned across all tuners this process."""
+    return sum(_pruned_counts.values())
+
+
+def _note_pruned(name: str, n: int) -> None:
+    _pruned_counts[name] = _pruned_counts.get(name, 0) + n
+    try:
+        metrics().inc("autotune_pruned_configs", n,
+                      labels={"tuner": name})
+    except Exception:
+        pass  # metrics are best-effort; pruning accounting must not raise
+
+
+def _device_kind() -> str:
+    """Kind string of device 0 ("TPU v5e", "cpu", ...) for the cache key.
+    Module-level so tests can monkeypatch it to simulate hardware kinds
+    without real devices; failure degrades to "unknown" rather than
+    breaking tuning."""
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"
+
 
 def _cache_path() -> str:
     return os.environ.get("TDT_AUTOTUNE_CACHE", _DEFAULT_CACHE)
@@ -143,13 +194,23 @@ class ContextualAutotuner:
                  iters: tuple[int, int] = (8, 24), calls: int = 3,
                  timer: Callable[[Callable], float] | None = None,
                  multi_timer: Callable[[Sequence[Callable]],
-                                       Sequence[float]] | None = None):
+                                       Sequence[float]] | None = None,
+                 pruner: Callable[[Any], Sequence[Any]] | None = None):
         if not configs:
             raise ValueError("need at least one config")
         self.name = name
         self.configs = list(configs)
         self.iters = iters
         self.calls = calls
+        # Static feasibility analyzer: ``pruner(config) -> findings``. A
+        # non-empty findings list rejects the config BEFORE any compile or
+        # timing (make_thunk is never called for it) — the
+        # analysis.resources config-pruner hook. The pruner must be
+        # DETERMINISTIC across processes (pure static analysis of the
+        # config) or SPMD processes would time different candidate sets;
+        # an exception inside it never prunes (analyzer bugs degrade to
+        # "time everything", not "tune nothing").
+        self.pruner = pruner
         # Custom ms-estimator for one candidate (overrides perf_thunk) —
         # used where the thunk shape allows better amortization than
         # host-looped dispatches (see slope_timer).
@@ -170,10 +231,14 @@ class ContextualAutotuner:
     def _key(self, context_key: str) -> str:
         # The cached value is an INDEX into self.configs: the key must pin
         # the candidate list, or editing it would silently remap stale
-        # cached indices onto different configs.
+        # cached indices onto different configs. The device kind and jax
+        # version are part of the key because the disk cache file outlives
+        # both: a winner tuned on v5e is not a winner on v6e, and a jax
+        # upgrade can change what a config compiles to.
         digest = hashlib.sha256(
             repr(self.configs).encode()).hexdigest()[:10]
-        return f"{self.name}|{context_key}|{digest}|{self._METHODOLOGY}"
+        return (f"{self.name}|{context_key}|{digest}|{self._METHODOLOGY}"
+                f"|{_device_kind()}|jax{jax.__version__}")
 
     def peek(self, context_key: str):
         """The cached winner for this context, or None — NEVER times or
@@ -242,9 +307,39 @@ class ContextualAutotuner:
             _memory_cache[key] = cached
             return self.configs[cached]
 
+        # Static pruning pass: analyzer-rejected configs are excluded from
+        # the competition before anything compiles — make_thunk is never
+        # called for them and they carry inf into the timing vote. The
+        # prune decision is deterministic static analysis, so every SPMD
+        # process computes the same set and the collective vote stays
+        # aligned. If the analyzer rejects EVERY candidate it is
+        # distrusted wholesale (warn + time everything) rather than left
+        # to crash the tune.
+        pruned: set[int] = set()
+        if self.pruner is not None:
+            for i, cfg in enumerate(self.configs):
+                try:
+                    findings = self.pruner(cfg)
+                except Exception:
+                    findings = None  # analyzer failure never prunes
+                if findings:
+                    pruned.add(i)
+            if len(pruned) == len(self.configs):
+                warnings.warn(
+                    f"autotune {self.name}: resource pruner rejected all "
+                    f"{len(self.configs)} candidate configs — ignoring the "
+                    f"pruner and timing everything (its model is likely "
+                    f"wrong for this context)")
+                pruned = set()
+            if pruned:
+                _note_pruned(self.name, len(pruned))
+
         if self.multi_timer is not None:
             thunks = []
-            for cfg in self.configs:
+            for i, cfg in enumerate(self.configs):
+                if i in pruned:
+                    thunks.append(None)  # statically rejected: never built
+                    continue
                 try:
                     thunks.append(make_thunk(cfg))
                 except Exception:
@@ -252,7 +347,10 @@ class ContextualAutotuner:
             timings = list(self.multi_timer(thunks))
         else:
             timings = []
-            for cfg in self.configs:
+            for i, cfg in enumerate(self.configs):
+                if i in pruned:
+                    timings.append(float("inf"))  # statically rejected
+                    continue
                 try:
                     thunk = make_thunk(cfg)
                     if self.timer is not None:

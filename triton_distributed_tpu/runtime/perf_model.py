@@ -35,15 +35,28 @@ class Hardware:
     ici_hop_lat: float     # seconds per ICI hop (DMA issue + wire)
     dcn_bw: float          # bytes/s per host, inter-slice
     dcn_lat: float         # seconds per DCN transfer
+    # On-core scratchpad capacities (bytes), feeding the static resource
+    # analyzer (analysis/resources.py). VMEM is per TensorCore; all the
+    # generations we model ship 128 MiB/core except v4 (32 MiB over two
+    # cores -> 16 MiB each in the megacore-off worst case is too tight;
+    # public docs say 32 MiB/core with megacore). SMEM (scalar memory,
+    # where pltpu SMEM refs and semaphores live) is ~1 MiB-class on all of
+    # them; we model 1 MiB flat.
+    vmem_bytes: int = 128 * 2**20
+    smem_bytes: int = 1 * 2**20
 
 
 _HW_TABLE = {
     # jax device_kind (prefix-matched, lowercase) -> figures
     "tpu v5 lite": Hardware("v5e", 197e12, 819e9, 45e9, 4, 1e-6,
-                            25e9, 10e-6),
-    "tpu v5": Hardware("v5p", 459e12, 2765e9, 90e9, 6, 1e-6, 25e9, 10e-6),
-    "tpu v4": Hardware("v4", 275e12, 1228e9, 45e9, 6, 1e-6, 25e9, 10e-6),
-    "tpu v6": Hardware("v6e", 918e12, 1640e9, 90e9, 4, 1e-6, 25e9, 10e-6),
+                            25e9, 10e-6,
+                            vmem_bytes=128 * 2**20, smem_bytes=1 * 2**20),
+    "tpu v5": Hardware("v5p", 459e12, 2765e9, 90e9, 6, 1e-6, 25e9, 10e-6,
+                       vmem_bytes=128 * 2**20, smem_bytes=1 * 2**20),
+    "tpu v4": Hardware("v4", 275e12, 1228e9, 45e9, 6, 1e-6, 25e9, 10e-6,
+                       vmem_bytes=32 * 2**20, smem_bytes=1 * 2**20),
+    "tpu v6": Hardware("v6e", 918e12, 1640e9, 90e9, 4, 1e-6, 25e9, 10e-6,
+                       vmem_bytes=128 * 2**20, smem_bytes=1 * 2**20),
 }
 # Marketing / short device_kind spellings (substring-matched AFTER the
 # canonical prefixes): bench.py's old private table matched these, so the

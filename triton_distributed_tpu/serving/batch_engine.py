@@ -281,6 +281,16 @@ class BatchEngine:
         out["pool_free_blocks"] = float(frag["free_blocks"])
         out["pool_largest_free_run"] = float(frag["largest_free_run"])
         out["pool_frag_frac"] = float(frag["frag_frac"])
+        # Autotune-search shrinkage this process (configs the resource
+        # analyzer rejected before timing — e.g. the paged-tile pruner).
+        try:
+            from triton_distributed_tpu.runtime.autotuner import (
+                pruned_configs_total,
+            )
+
+            out["pruned_configs"] = float(pruned_configs_total())
+        except Exception:
+            pass
         return out
 
     def _call_step(self, site: str, fn):
