@@ -576,6 +576,104 @@ def _bench_probe_overhead() -> dict:
     }
 
 
+def _bench_serve_prefix() -> dict:
+    """The ``--serve`` arm: prefix-heavy serving trace through the
+    BatchEngine's radix prefix cache (serving/prefix_cache.py).
+
+    Workload: 4 shared 64-token prompt templates with Zipf(1/rank)
+    popularity — the chat-system-prompt / few-shot-template shape — each
+    request appending a short unique suffix. Three passes over the SAME
+    engine (so both compiled steps are identical executables throughout):
+    a COLD pass with the cache toggled off (host-side flag, no recompile),
+    a seeding pass that populates the tree, and a WARM pass that adopts
+    cached blocks and starts prefill at the match point. Headline metric
+    is the warm-pass hit rate; extras carry the cold/warm TTFT p50s and
+    their ratio (``ttft_warm_over_cold`` — lower-better override in
+    perfdb), the cached-token fraction, a bit-identity verdict (warm
+    tokens must equal cold tokens request-for-request), and the retrace
+    count (must stay 0: a cache hit is data, not shape)."""
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    be = BatchEngine(engine, n_slots=4, n_blocks=48, block_size=16,
+                     prefill_chunk=32)
+    rng = np.random.default_rng(0)
+    n_req, n_templates, gen = 20, 4, 8
+    templates = [rng.integers(0, config.vocab_size, size=64).tolist()
+                 for _ in range(n_templates)]
+    zipf = 1.0 / (1.0 + np.arange(n_templates))
+    picks = rng.choice(n_templates, size=n_req, p=zipf / zipf.sum())
+    prompts = [templates[t]
+               + rng.integers(0, config.vocab_size,
+                              size=int(rng.integers(8, 17))).tolist()
+               for t in picks]
+
+    def run_pass(tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        done = be.run(max_steps=5000)
+        ttfts = sorted((be.finished[r].first_token_t
+                        - be.finished[r].submit_t) for r in rids)
+        return [done[r] for r in rids], ttfts[len(ttfts) // 2]
+
+    be.prefix_cache.enabled = False
+    be.submit(prompts[0], max_new_tokens=gen, req_id="compile-warmup")
+    be.run(max_steps=5000)                 # compile both steps off the clock
+    # ... and the CoW block-copy kernel (first partial-prefix adoption
+    # would otherwise pay its compile inside the timed warm pass). A
+    # self-copy of a free block is a no-op for pool contents.
+    be.pool._copy_block_device(0, 0)
+    cold_out, ttft_cold_p50 = run_pass("cold")
+
+    be.prefix_cache.enabled = True
+    run_pass("seed")                       # populate the radix tree
+    m0 = be.metrics.as_dict()
+    warm_out, ttft_warm_p50 = run_pass("warm")
+    m1 = be.metrics.as_dict()
+
+    be.pool.check_invariants()
+    bit_identical = warm_out == cold_out
+    lookups = m1.get("prefix_lookups", 0) - m0.get("prefix_lookups", 0)
+    hits = m1.get("prefix_hits", 0) - m0.get("prefix_hits", 0)
+    cached = (m1.get("prefix_cached_tokens", 0)
+              - m0.get("prefix_cached_tokens", 0))
+    uncached = (m1.get("prefix_uncached_tokens", 0)
+                - m0.get("prefix_uncached_tokens", 0))
+    retraces = be.trace_counts["decode"] + be.trace_counts["prefill"] - 2
+    if not bit_identical:
+        raise RuntimeError("warm-cache output diverged from cold pool")
+    if retraces:
+        raise RuntimeError(f"prefix caching retraced {retraces} time(s)")
+    hit_rate = hits / lookups if lookups else 0.0
+    extras = {
+        "prefix_cached_token_frac": round(cached / (cached + uncached), 4)
+        if cached + uncached else 0.0,
+        "ttft_cold_p50_ms": round(ttft_cold_p50 * 1e3, 2),
+        "ttft_warm_p50_ms": round(ttft_warm_p50 * 1e3, 2),
+        "ttft_warm_over_cold": round(ttft_warm_p50 / ttft_cold_p50, 4),
+        "serve_prefix_requests": n_req,
+        "serve_prefix_retraces": int(retraces),
+        "serve_prefix_bit_identical": bit_identical,
+        "serve_prefix_evictions": int(
+            m1.get("prefix_evicted_blocks", 0)),
+    }
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "prefix_hit_rate",
+        "value": round(hit_rate, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -616,6 +714,26 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path, suite="probe_overhead")
+        return
+
+    # --serve: prefix-cache serving arm on the tiny model. Also BEFORE the
+    # backend probe: it runs anywhere, and its hit-rate / bit-identity /
+    # retrace checks are platform-independent (the TTFT ratio is the only
+    # timing-sensitive number, and it compares two passes of the same
+    # process against each other).
+    if "--serve" in sys.argv:
+        try:
+            result = _bench_serve_prefix()
+        except Exception as e:  # noqa: BLE001
+            result = {
+                "backend": "error",
+                "metric": "prefix_hit_rate",
+                "value": None,
+                "unit": "frac",
+                "error": f"{type(e).__name__}: {str(e)[:200]}",
+            }
+        print(json.dumps(result))
+        _record_perfdb(result, perfdb_path, suite="serve_prefix")
         return
 
     # Backend probe FIRST: everything below (compile cache, device queries)

@@ -15,7 +15,12 @@
 #                                           identity asserted anywhere;
 #                                           <= 5% overhead enforced where
 #                                           the arm gates, i.e. on TPU)
-#   5. tools/perf_gate.py --db ...       -> compare newest vs history,
+#   5. python bench.py --serve           -> prefix-cache serving arm:
+#                                           warm-vs-cold TTFT through the
+#                                           radix cache (hit rate > 0,
+#                                           bit-identity and 0 retraces
+#                                           hard-checked anywhere)
+#   6. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -100,6 +105,29 @@ if ex.get("probe_overhead_gated"):
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_prefix run $i/2" >&2
+  python bench.py --serve --perfdb "$DB" \
+    > "$WORKDIR/serve_prefix_out.$i.json"
+  python - "$WORKDIR/serve_prefix_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+# The acceptance bar (ISSUE 9): the prefix-heavy trace must actually HIT
+# (hit rate > 0, cached tokens adopted), warm output must be bit-identical
+# to the cold pool, a cache hit must never retrace, and the warm pass must
+# beat the cold pass on TTFT (the whole point of the subsystem).
+assert obj["value"] is not None and obj["value"] > 0.0, obj["value"]
+ex = obj.get("extras", {})
+assert ex.get("prefix_cached_token_frac", 0.0) > 0.0, ex
+assert ex.get("serve_prefix_bit_identical") is True, ex
+assert ex.get("serve_prefix_retraces") == 0, ex
+assert ex.get("ttft_warm_over_cold", 99.0) < 1.0, ex
+EOF
+done
+
 echo "perf_gate_smoke: gating serve_smoke suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_smoke \
   --tolerance "$TOL" --report "$WORKDIR/serve_report.md"
@@ -115,5 +143,9 @@ python tools/perf_gate.py --db "$DB" --suite paged_attn \
 echo "perf_gate_smoke: gating probe_overhead suite" >&2
 python tools/perf_gate.py --db "$DB" --suite probe_overhead \
   --tolerance "$TOL" --report "$WORKDIR/probe_overhead_report.md"
+
+echo "perf_gate_smoke: gating serve_prefix suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_prefix \
+  --tolerance "$TOL" --report "$WORKDIR/serve_prefix_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

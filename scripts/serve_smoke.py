@@ -117,7 +117,9 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     m["comm_ledger"] = comm_ledger.snapshot()
     m["ledger_selfcheck"] = comm_ledger.selfcheck()
     be.pool.check_invariants()
-    if be.pool.n_free != be.pool.n_blocks:
+    # After drain every block is either free or parked in the prefix cache
+    # with zero references (reclaimable). Anything else is a leak.
+    if be.pool.n_free + be.pool.n_reclaimable != be.pool.n_blocks:
         raise RuntimeError("KV pool leaked blocks after drain")
     completed = int(m["requests_completed"])
     failed = int(m.get("requests_failed", 0))

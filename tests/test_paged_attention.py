@@ -242,7 +242,8 @@ def test_pool_fragmentation_stat():
     config = ModelConfig.from_name("tiny")
     pool = KVPool(config, n_blocks=8, block_size=4, max_seq_len=32)
     f = pool.fragmentation()
-    assert f == {"free_blocks": 8, "largest_free_run": 8, "frag_frac": 0.0}
+    assert f == {"free_blocks": 8, "largest_free_run": 8, "frag_frac": 0.0,
+                 "cached_blocks": 0}
     # checkerboard the pool: a/b interleave, release a -> shredded free set
     assert pool.ensure("a", 4 * 4) and pool.ensure("b", 4 * 4)
     a_blocks = sorted(pool.table("a"))
@@ -293,9 +294,12 @@ def test_batch_engine_fused_matches_gather_and_golden(engine):
         be.pool.check_invariants()
         sample = be.perfdb_sample()
         for key in ("pool_free_blocks", "pool_largest_free_run",
-                    "pool_frag_frac"):
+                    "pool_frag_frac", "pool_cached_blocks"):
             assert key in sample
-        assert sample["pool_free_blocks"] == float(be.pool.n_blocks)
+        # drained: free + cache-parked (all unreferenced) covers the pool
+        assert (sample["pool_free_blocks"] + sample["pool_cached_blocks"]
+                == float(be.pool.n_blocks))
+        assert be.pool.n_reclaimable == be.pool.n_cached
         outs[method] = [np.asarray(done[r], np.int32) for r in rids]
     for f, g_, p in zip(outs["fused"], outs["gather"], prompts):
         np.testing.assert_array_equal(f, g_, err_msg="fused != gather")

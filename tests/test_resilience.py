@@ -250,7 +250,8 @@ def test_quarantined_request_leaves_survivors_bit_identical(setup):
     # failure handling never re-specialized the compiled steps
     assert be.trace_counts == {"decode": 1, "prefill": 1}
     be.pool.check_invariants()
-    assert be.pool.n_free == be.pool.n_blocks
+    # drained: every block is free or prefix-cached with zero references
+    assert be.pool.n_free + be.pool.n_reclaimable == be.pool.n_blocks
 
 
 def test_transient_step_faults_are_invisible_after_retry(setup):
@@ -296,7 +297,49 @@ def test_chaos_plan_run_completes_and_accounts(setup):
         assert req.status == "failed" and req.error
     assert be.trace_counts == {"decode": 1, "prefill": 1}
     be.pool.check_invariants()
-    assert be.pool.n_free == be.pool.n_blocks
+    # drained: every block is free or prefix-cached with zero references
+    assert be.pool.n_free + be.pool.n_reclaimable == be.pool.n_blocks
+
+
+def test_faulted_cache_lookup_degrades_to_cold_prefill(setup):
+    """Satellite: a faulted ``cache.lookup`` must read as a cache MISS —
+    the request re-prefills cold, emits bit-identical output, scores zero
+    hits, and leaves every refcount exactly as it was (the fault site
+    fires before the cache touches any state)."""
+    _, config, engine = setup
+    be = BatchEngine(engine, n_slots=2, n_blocks=16, block_size=4,
+                     prefill_chunk=8)
+    prompt = [5, 3, 5, 3, 5, 3, 5, 3, 2]
+    golden = _golden(engine, prompt, 4).tolist()
+    be.submit(prompt, max_new_tokens=4, req_id="warm")
+    out = be.run()
+    assert out["warm"] == golden
+    assert be.pool.n_cached > 0           # the tree is populated
+    cached_before = sorted(be.pool._cached.items())
+    # now EVERY lookup faults: the identical prompt would have hit
+    plan = FaultPlan([FaultSpec(site="cache.lookup", kind="error", p=1.0)])
+    install_hooks(plan=plan)
+    try:
+        be.submit(prompt, max_new_tokens=4, req_id="again")
+        out = be.run()
+    finally:
+        uninstall_hooks()
+    assert plan.n_fired > 0               # the site actually bit
+    assert out["again"] == golden         # cold prefill, correct output
+    m = be.metrics.as_dict()
+    assert m.get("prefix_hits", 0) == 0   # degraded, not served from cache
+    assert m["prefix_lookup_faults"] > 0
+    assert be.trace_counts == {"decode": 1, "prefill": 1}
+    be.pool.check_invariants()
+    # refcounts untouched by the faulted lookups: same resident set, all
+    # references back to zero after the drain
+    assert sorted(be.pool._cached.items()) == cached_before
+    assert be.pool.n_free + be.pool.n_reclaimable == be.pool.n_blocks
+    # control: with the plan gone the same prompt DOES hit
+    be.submit(prompt, max_new_tokens=4, req_id="hit")
+    out = be.run()
+    assert out["hit"] == golden
+    assert be.metrics.as_dict()["prefix_hits"] >= 1
 
 
 def test_disabled_plan_is_bit_identical(setup):

@@ -18,8 +18,8 @@ import pytest
 
 from triton_distributed_tpu.models import Engine, ModelConfig
 from triton_distributed_tpu.runtime.mesh import make_mesh
-from triton_distributed_tpu.serving import BatchEngine, KVPool, Request, \
-    Scheduler
+from triton_distributed_tpu.serving import BatchEngine, KVPool, \
+    RadixPrefixCache, Request, Scheduler
 
 
 @pytest.fixture(scope="module")
@@ -67,6 +67,73 @@ def test_pool_alloc_free_invariants(setup):
         pool.ensure("z", 33)             # beyond max_seq_len
 
 
+def test_pool_invariants_under_cache_adoption_stress(setup):
+    """Satellite: several hundred random interleavings of ensure / grow /
+    finish-and-insert / preempt-release, with prefix-cache adoption (by
+    reference AND by CoW) in the mix. ``check_invariants`` — including the
+    refcount == table-occurrence agreement — and ``fragmentation()``
+    accounting must hold after EVERY mutation."""
+    _, config, _ = setup
+    pool = KVPool(config, n_blocks=12, block_size=4, max_seq_len=32)
+    cache = RadixPrefixCache(pool)
+    rng = np.random.default_rng(42)
+    live: dict[str, list[int]] = {}       # seq_id -> token stream
+    next_id = 0
+
+    def check():
+        pool.check_invariants()
+        f = pool.fragmentation()
+        assert f["free_blocks"] == pool.n_free
+        assert f["cached_blocks"] == pool.n_cached
+        assert (pool.n_used - pool.n_cached) + pool.n_cached + pool.n_free \
+            == pool.n_blocks
+
+    for step in range(400):
+        op = rng.choice(["admit", "grow", "finish", "preempt"])
+        if op == "admit" and len(live) < 4:
+            # shared-prefix population: few distinct streams, many repeats
+            base = [int(t) for t in
+                    rng.integers(0, 8, size=int(rng.integers(6, 20)))]
+            if rng.random() < 0.6 and live:
+                base = next(iter(live.values()))[:len(base)] or base
+            sid = f"s{next_id}"
+            next_id += 1
+            m = cache.match(base, max_len=len(base) - 1)
+            ok = pool.ensure(sid, len(base) + 1, adopt=m.blocks,
+                             cow_src=m.cow_src)
+            if ok:
+                live[sid] = base
+        elif op == "grow" and live:
+            sid = list(live)[int(rng.integers(len(live)))]
+            toks = live[sid]
+            if len(toks) < 28:
+                toks.append(int(rng.integers(0, 8)))
+                if not pool.ensure(sid, len(toks) + 1):
+                    # pool full even after LRU reclaim: preempt instead
+                    pool.release(sid)
+                    del live[sid]
+        elif op == "finish" and live:
+            sid = list(live)[int(rng.integers(len(live)))]
+            cache.insert(sid, live[sid])
+            pool.release(sid)
+            del live[sid]
+        elif op == "preempt" and live:
+            # eviction-by-recompute: release WITHOUT inserting
+            sid = list(live)[int(rng.integers(len(live)))]
+            pool.release(sid)
+            del live[sid]
+        check()
+
+    for sid in list(live):
+        pool.release(sid)
+    check()
+    assert pool.n_free + pool.n_reclaimable == pool.n_blocks
+    # and the whole cache is evictable once nobody references it
+    cache.drop()
+    check()
+    assert pool.n_free == pool.n_blocks and pool.n_cached == 0
+
+
 # -- 2. scheduler policy ----------------------------------------------------
 
 def test_scheduler_fifo_and_priority():
@@ -91,6 +158,71 @@ def test_scheduler_admission_budget():
     r = s.pop()
     s.requeue(r)
     assert s.peek().req_id == 1
+
+
+def test_scheduler_admission_delegates_block_rounding(setup):
+    """`blocks_for` (pool or callable) must agree with the legacy
+    block_size path — one rounding rule, never two."""
+    _, config, _ = setup
+    pool = KVPool(config, n_blocks=8, block_size=4, max_seq_len=32)
+
+    def fill(s):
+        for i, plen in enumerate([7, 7, 3]):
+            s.submit(Request(req_id=i, prompt=[1] * plen, max_new_tokens=1))
+        return s
+
+    got_bs = fill(Scheduler()).admit(free_slots=3, free_blocks=3,
+                                     block_size=4)
+    got_pool = fill(Scheduler()).admit(free_slots=3, free_blocks=3,
+                                       blocks_for=pool)
+    got_fn = fill(Scheduler()).admit(free_slots=3, free_blocks=3,
+                                     blocks_for=pool.blocks_for,
+                                     block_size=pool.block_size)
+    assert ([r.req_id for r in got_bs] == [r.req_id for r in got_pool]
+            == [r.req_id for r in got_fn] == [0])
+    with pytest.raises(TypeError):
+        Scheduler().admit(free_slots=1, free_blocks=1)
+
+
+def test_scheduler_admission_discounts_cached_prefix():
+    """A mostly-cached request fits where a cold one would not: only the
+    uncached suffix is charged (full blocks only — a CoW tail still costs
+    a fresh block)."""
+    s = Scheduler()
+    s.submit(Request(req_id="big", prompt=[1] * 11, max_new_tokens=1))
+    # cold: needs ceil(12/4)=3 blocks > 1 available
+    assert not s.admit(free_slots=1, free_blocks=1, block_size=4)
+    # warm: 8 of 11 prompt tokens cached -> 2 full blocks adopted free
+    got = s.admit(free_slots=1, free_blocks=1, block_size=4,
+                  match_len=lambda r: 8)
+    assert [r.req_id for r in got] == ["big"]
+    # the discount is capped at context_len-1 and floored to full blocks:
+    # a 9-token "match" of an 8-token context counts 7 -> 1 block
+    s2 = Scheduler()
+    s2.submit(Request(req_id="edge", prompt=[1] * 8, max_new_tokens=1))
+    assert not s2.admit(free_slots=1, free_blocks=1, block_size=4,
+                        match_len=lambda r: 9)   # 3 - 7//4 = 2 > 1
+    assert s2.admit(free_slots=1, free_blocks=2, block_size=4,
+                    match_len=lambda r: 9)
+    with pytest.raises(TypeError):
+        # a bare callable gives no block size to floor the discount with
+        s2.admit(free_slots=1, free_blocks=1,
+                 blocks_for=lambda n: -(-n // 4), match_len=lambda r: 4)
+
+
+def test_padded_tables_unknown_seq_raises(setup):
+    """An unknown seq_id must raise, not emit an all-zero table (which is
+    indistinguishable from a real table pointing at block 0)."""
+    _, config, _ = setup
+    pool = KVPool(config, n_blocks=4, block_size=4, max_seq_len=16)
+    assert pool.ensure("a", 4)
+    t = pool.padded_tables(["a", None])         # None = empty slot, fine
+    assert t.shape == (2, pool.max_blocks_per_seq)
+    with pytest.raises(KeyError):
+        pool.padded_tables(["a", "ghost"])
+    pool.release("a")
+    with pytest.raises(KeyError):
+        pool.padded_tables(["a"])               # released = unknown again
 
 
 def test_scheduler_victim_selection():
@@ -132,7 +264,10 @@ def test_batched_matches_independent_engines(setup):
     # the one-compile-across-churn guarantee
     assert be.trace_counts == {"decode": 1, "prefill": 1}
     be.pool.check_invariants()
-    assert be.pool.n_free == be.pool.n_blocks   # everything released
+    # Everything released: finished requests park their blocks in the
+    # prefix cache (resident, zero refs) instead of freeing them.
+    assert be.pool.n_free + be.pool.n_reclaimable == be.pool.n_blocks
+    assert be.pool.n_reclaimable == be.pool.n_cached  # no live readers
     m = be.metrics.as_dict()
     assert m["requests_completed"] == len(specs)
     assert m["tokens_generated"] == sum(g for _, g in specs)

@@ -228,11 +228,15 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 # misread. "bytes_ratio" (bench --paged-attn: fused/gather HBM traffic)
 # contains "ratio" but fewer bytes win — without the override the gate
 # would wave a traffic regression through as an improvement. Same for
-# "overhead_frac" (bench --probe-overhead: telemetry cost vs plain build).
-_LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac")
+# "overhead_frac" (bench --probe-overhead: telemetry cost vs plain build)
+# and "warm_over_cold" (bench --serve: warm/cold TTFT ratio — a warm
+# prefix cache should shrink it, despite the "ratio"/"_cold" spelling).
+_LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
+                           "warm_over_cold")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
-                        "_completed", "requests_ok", "flops", "gbps")
+                        "_completed", "requests_ok", "flops", "gbps",
+                        "hit_rate")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
 
 

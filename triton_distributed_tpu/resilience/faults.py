@@ -15,6 +15,10 @@ Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
 
   sched.admit          Scheduler admission (serving/batch_engine._admit)
   pool.ensure          KV-pool block allocation (serving/kv_pool.ensure)
+  cache.lookup         prefix-cache match / match_len probes
+                       (serving/prefix_cache) — fires BEFORE any tree or
+                       refcount state is read, so a faulted lookup
+                       degrades the admission to a cold prefill
   engine.decode        the batched decode step (serving/batch_engine)
   engine.prefill       the batched mixed/prefill step
   comm.<collective>    every host-level collective wrapper in kernels/
@@ -163,6 +167,8 @@ def default_chaos_plan(seed: int = 0, *, error_p: float = 0.08,
                   start_after=1),
         FaultSpec(site="pool.ensure", kind="error", p=error_p / 2,
                   start_after=2),
+        FaultSpec(site="cache.lookup", kind="error", p=error_p / 2,
+                  start_after=1),
         FaultSpec(site="engine.decode", kind="nan", p=nan_p, row=nan_row,
                   start_after=1),
     ]

@@ -8,13 +8,18 @@ two fixed-shape compiled steps. See docs/serving.md for the design note.
   KVPool / PagedKVState  — block-paged KV memory + free-list allocator
   Scheduler / Request    — priority-FIFO queue, admission, eviction policy
   BatchEngine            — the compiled decode/mixed steps + serve loop
+  RadixPrefixCache       — content-addressed, ref-counted KV block reuse
   Metrics                — counters / gauges / histograms for the above
 """
 
 from triton_distributed_tpu.serving.batch_engine import BatchEngine
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Histogram, Metrics
+from triton_distributed_tpu.serving.prefix_cache import (
+    PrefixMatch,
+    RadixPrefixCache,
+)
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
 
 __all__ = ["BatchEngine", "KVPool", "PagedKVState", "Histogram", "Metrics",
-           "Request", "Scheduler"]
+           "PrefixMatch", "RadixPrefixCache", "Request", "Scheduler"]

@@ -58,6 +58,7 @@ from triton_distributed_tpu.resilience import faults as _faults
 from triton_distributed_tpu.resilience import guards as _guards
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
+from triton_distributed_tpu.serving.prefix_cache import RadixPrefixCache
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
 
 
@@ -105,6 +106,17 @@ class BatchEngine:
                    (``paged_gather_kv``), the escape hatch the fused kernel
                    is verified token-identical against. Baked into the
                    compiled steps at construction.
+    ``prefix_cache`` attach a ``RadixPrefixCache`` (default True): finished
+                   requests donate their KV blocks to a radix tree over
+                   token prefixes, and admissions that share a cached
+                   prefix adopt those blocks and start chunked prefill at
+                   the match point. Pure host-side data — a hit changes
+                   the (offsets, block_tables) operands, never a shape —
+                   so ``trace_counts`` stays {1,1} and greedy output stays
+                   bit-identical to a cold pool (the KV a request would
+                   have computed IS the cached KV, token for token).
+                   ``engine.prefix_cache.enabled = False`` toggles it off
+                   at runtime without touching compiled state.
     """
 
     def __init__(self, engine: Engine, *, n_slots: int = 8,
@@ -112,7 +124,8 @@ class BatchEngine:
                  prefill_chunk: int = 32, max_seq_len: int | None = None,
                  seed: int = 0, admission_pressure: float = 0.0,
                  retry: _guards.RetryPolicy | None = None,
-                 nan_guard: bool = False, paged_attn: str = "fused"):
+                 nan_guard: bool = False, paged_attn: str = "fused",
+                 prefix_cache: bool = True):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
                 f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
@@ -132,6 +145,9 @@ class BatchEngine:
                            mesh=engine.mesh, axis=engine.model.axis)
         self.scheduler = Scheduler()
         self.metrics = Metrics()
+        self.prefix_cache = (RadixPrefixCache(self.pool,
+                                              metrics=self.metrics)
+                             if prefix_cache else None)
         self.trace_counts = {"decode": 0, "prefill": 0}
         self._slots: list[_Slot | None] = [None] * n_slots
         self._admit_seq = 0
@@ -247,7 +263,9 @@ class BatchEngine:
             "queue_depth": len(self.scheduler),
             "pool": {"n_blocks": self.pool.n_blocks,
                      "n_free": self.pool.n_free,
-                     "n_used": self.pool.n_used},
+                     "n_used": self.pool.n_used,
+                     "n_cached": self.pool.n_cached,
+                     "n_reclaimable": self.pool.n_reclaimable},
             "requests": {"completed": len(self._finished),
                          "failed": len(self._failed)},
             "faults_fired": plan.n_fired if plan is not None else 0,
@@ -281,6 +299,17 @@ class BatchEngine:
         out["pool_free_blocks"] = float(frag["free_blocks"])
         out["pool_largest_free_run"] = float(frag["largest_free_run"])
         out["pool_frag_frac"] = float(frag["frag_frac"])
+        out["pool_cached_blocks"] = float(frag["cached_blocks"])
+        # Prefix-cache effectiveness: hit rate over adoption-time lookups
+        # and the fraction of admitted prompt tokens served from cache.
+        lookups = m.get("prefix_lookups", 0.0)
+        if lookups:
+            out["prefix_hit_rate"] = float(
+                m.get("prefix_hits", 0.0)) / float(lookups)
+        ct = m.get("prefix_cached_tokens", 0.0)
+        ut = m.get("prefix_uncached_tokens", 0.0)
+        if ct + ut:
+            out["prefix_cached_token_frac"] = float(ct) / float(ct + ut)
         # Autotune-search shrinkage this process (configs the resource
         # analyzer rejected before timing — e.g. the paged-tile pruner).
         try:
@@ -332,12 +361,21 @@ class BatchEngine:
         return self.retry.run(attempt, on_retry=on_retry,
                               on_recovery=on_recovery)
 
-    def _ensure_blocks(self, seq_id, n_tokens: int) -> bool:
+    def _ensure_blocks(self, seq_id, n_tokens: int, *, match=None) -> bool:
         """``pool.ensure`` through the retry policy (the ``pool.ensure``
-        fault site fires inside ``KVPool.ensure`` itself). Raises
-        ``TransientFault`` only after the retry budget is spent."""
+        fault site fires inside ``KVPool.ensure`` itself). ``match`` (a
+        ``PrefixMatch`` from ``_cache_match``) routes adopted cache blocks
+        into the new table. Raises ``TransientFault`` only after the retry
+        budget is spent."""
+        adopt = match.blocks if match is not None else ()
+        cow = match.cow_src if match is not None else None
+
+        def ensure():
+            return self.pool.ensure(seq_id, n_tokens, adopt=adopt,
+                                    cow_src=cow)
+
         if _faults._PLAN is None:
-            return self.pool.ensure(seq_id, n_tokens)
+            return ensure()
 
         def on_retry(attempt_i, exc):
             self.metrics.inc("faults_injected")
@@ -349,8 +387,41 @@ class BatchEngine:
             self.metrics.inc("alloc_recoveries")
             self.metrics.observe("recovery_s", seconds)
 
-        return self.retry.run(lambda: self.pool.ensure(seq_id, n_tokens),
-                              on_retry=on_retry, on_recovery=on_recovery)
+        return self.retry.run(ensure, on_retry=on_retry,
+                              on_recovery=on_recovery)
+
+    # -- prefix cache plumbing ----------------------------------------------
+
+    def _probe_match_len(self, req: Request) -> int:
+        """Side-effect-free cached-prefix probe for the scheduler's
+        admission budget. A faulted lookup reads as 0 cached tokens — the
+        budget just turns conservative."""
+        try:
+            return self.prefix_cache.match_len(
+                req.prompt + req.output,
+                max_len=max(req.context_len - 1, 0))
+        except _faults.TransientFault as e:
+            self.metrics.inc("faults_injected")
+            self.metrics.inc("prefix_lookup_faults")
+            _trace.instant("fault_cache_lookup", phase="probe", error=str(e))
+            return 0
+
+    def _cache_match(self, ctx: list[int]):
+        """Adoption-time lookup (the one that counts): longest cached
+        prefix of ``ctx``, capped one token short so the admission still
+        recomputes a token and produces first-token logits. A faulted
+        lookup degrades to a cold miss — correct output, zero hit, no
+        refcount ever touched (the fault site fires before the cache reads
+        anything)."""
+        if self.prefix_cache is None or not self.prefix_cache.enabled:
+            return None
+        try:
+            return self.prefix_cache.match(ctx, max_len=len(ctx) - 1)
+        except _faults.TransientFault as e:
+            self.metrics.inc("faults_injected")
+            self.metrics.inc("prefix_lookup_faults")
+            _trace.instant("fault_cache_lookup", phase="match", error=str(e))
+            return None
 
     # -- request lifecycle --------------------------------------------------
 
@@ -382,10 +453,15 @@ class BatchEngine:
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return
+        # Cache-resident-but-unreferenced blocks are one eviction away from
+        # the free list, so budgets and backpressure count them as
+        # available — otherwise a warm cache would read as a full pool and
+        # park admission forever.
+        avail = self.pool.n_free + self.pool.n_reclaimable
         if (self.admission_pressure > 0.0
                 and len(free) < self.n_slots       # engine not idle
                 and len(self.scheduler)
-                and self.pool.n_free / self.pool.n_blocks
+                and avail / self.pool.n_blocks
                     < self.admission_pressure):
             # Backpressure: let the running residents drain before adding
             # contenders that would immediately trigger eviction churn.
@@ -404,13 +480,23 @@ class BatchEngine:
                 self.metrics.inc("admissions_deferred")
                 _trace.instant("fault_admit", error=str(e))
                 return
-        admitted = self.scheduler.admit(free_slots=len(free),
-                                        free_blocks=self.pool.n_free,
-                                        block_size=self.pool.block_size)
+        caching = (self.prefix_cache is not None
+                   and self.prefix_cache.enabled)
+        admitted = self.scheduler.admit(
+            free_slots=len(free), free_blocks=avail,
+            blocks_for=self.pool,
+            match_len=self._probe_match_len if caching else None)
         for req in admitted:
             ctx = req.prompt + req.output
+            # Match immediately before ensure — the budget probe above was
+            # advisory (an earlier ensure's reclaim may have evicted what
+            # it saw), but nothing can evict between this match and the
+            # ensure that pins/adopts its blocks.
+            m = self._cache_match(ctx) if caching else None
+            if m is not None and m.match_len == 0:
+                m = None
             try:
-                ok = self._ensure_blocks(req.req_id, len(ctx) + 1)
+                ok = self._ensure_blocks(req.req_id, len(ctx) + 1, match=m)
             except _faults.TransientFault:
                 # Allocator faulted past the retry budget: requeue rather
                 # than fail the request — admission hasn't touched a slot.
@@ -418,19 +504,37 @@ class BatchEngine:
                 self.metrics.inc("admissions_deferred")
                 _trace.instant("admit_deferred", req=req.req_id)
                 continue
-            assert ok, "scheduler admitted beyond the pool budget"
+            if not ok:
+                # The probe over-promised (probe-time match shrank, or
+                # reclaim came up short). Nothing was allocated; put the
+                # request back at its FIFO position and retry next step.
+                self.scheduler.requeue(req)
+                self.metrics.inc("admissions_deferred")
+                _trace.instant("admit_deferred", req=req.req_id)
+                continue
+            matched = m.match_len if m is not None else 0
             self._slots[free.pop(0)] = _Slot(req=req,
                                              admit_seq=self._admit_seq,
-                                             ctx=ctx)
+                                             ctx=ctx, offset=matched)
             self._admit_seq += 1
             self.metrics.inc("requests_admitted")
+            if caching:
+                # Hit accounting lives HERE, not in the cache: only an
+                # adoption that actually landed in a table counts.
+                if matched:
+                    self.metrics.inc("prefix_hits")
+                    if m.cow_src is not None:
+                        self.metrics.inc("prefix_cow_adoptions")
+                self.metrics.inc("prefix_cached_tokens", matched)
+                self.metrics.inc("prefix_uncached_tokens",
+                                 len(ctx) - matched)
             if req.n_preemptions == 0:
                 # First admission only: re-admissions after preemption would
                 # double-count the scheduler wait.
                 self.metrics.observe("queue_wait_s",
                                      time.monotonic() - req.submit_t)
-            _trace.instant("admit", req=req.req_id,
-                           ctx_len=len(ctx), readmit=req.n_preemptions > 0)
+            _trace.instant("admit", req=req.req_id, ctx_len=len(ctx),
+                           cached=matched, readmit=req.n_preemptions > 0)
 
     def _preempt(self, idx: int):
         s = self._slots[idx]
@@ -478,6 +582,14 @@ class BatchEngine:
         s = self._slots[idx]
         s.req.finish_t = time.monotonic()
         s.req.status = "ok"
+        if self.prefix_cache is not None and self.prefix_cache.enabled:
+            # Donate this sequence's KV to the radix tree BEFORE release:
+            # pool positions 0..offset-1 hold the KV of the full token
+            # stream's first ``offset`` tokens (the final emitted token was
+            # never written back). Insert promotes those blocks to cached;
+            # the release below then drops them to resident-only.
+            toks = (s.req.prompt + s.req.output)[:s.offset]
+            self.prefix_cache.insert(s.req.req_id, toks)
         self.pool.release(s.req.req_id)
         self._slots[idx] = None
         self._finished[s.req.req_id] = s.req
@@ -492,7 +604,11 @@ class BatchEngine:
         empty its slot, park it in ``failed`` with an error status. Pure
         host-side slot churn — the next step's (mask, tables, offsets)
         simply exclude the row, same as a finish, so nothing about the
-        compiled program or the surviving rows changes."""
+        compiled program or the surviving rows changes. Deliberately NO
+        ``prefix_cache.insert`` here: a quarantined sequence's KV is
+        suspect (NaN-poisoned logits, faulted steps) and must never become
+        shareable. ``release`` raises before mutating on an unknown seq,
+        so refcounts survive even a double-quarantine."""
         s = self._slots[idx]
         req = s.req
         req.status = "failed"
@@ -539,6 +655,8 @@ class BatchEngine:
         self.metrics.set_gauge("queue_depth", len(self.scheduler))
         self.metrics.set_gauge("active_slots", len(active))
         self.metrics.set_gauge("pool_free_blocks", self.pool.n_free)
+        self.metrics.set_gauge("pool_reclaimable_blocks",
+                               self.pool.n_reclaimable)
         self.metrics.set_gauge("pool_occupancy",
                                self.pool.n_used / self.pool.n_blocks)
         if not active:

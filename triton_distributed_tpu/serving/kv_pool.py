@@ -22,11 +22,27 @@ host-side control flow between compiled steps (the reference engine makes
 its CUDA-graph-replay decisions on host the same way), and the device step
 consumes only the resulting (block_tables, offsets, slot_mask) DATA — so
 alloc/free churn never retraces anything.
+
+Prefix caching (serving/prefix_cache.py) adds a third block state beside
+free and owned: CACHE-RESIDENT. A cached block holds the KV of one
+content-addressed token chunk and carries a reference count — the number
+of sequence tables currently containing it. ``ensure`` ADOPTS cached
+blocks at admission (incref, no allocation) instead of re-prefilling
+them, ``release`` decrements instead of freeing (the block stays resident
+for the next match), and a block whose prefix only partially matches is
+adopted by COPY-ON-WRITE — one device-side block copy into a private
+block the sequence may then overwrite. Unreferenced-but-resident blocks
+are the LRU eviction pool: when the free list runs short, ``ensure``
+reclaims through the attached cache before giving up. The partition
+free ∪ private-owned ∪ cached is exact and ``check_invariants`` proves it
+(including refcount == table-occurrence agreement) after every mutation.
 """
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import functools
 import math
 
 import jax
@@ -35,6 +51,14 @@ import numpy as np
 
 from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.resilience import faults as _faults
+
+
+def blocks_needed(n_tokens: int, block_size: int) -> int:
+    """THE block-rounding rule: ``ceil(n_tokens / block_size)``. One
+    definition shared by allocation (``KVPool.blocks_for``) and admission
+    accounting (``Scheduler.admit``) so the two can never disagree on how
+    many blocks a sequence costs."""
+    return math.ceil(n_tokens / block_size)
 
 
 @jax.tree_util.register_dataclass
@@ -84,6 +108,12 @@ class KVPool:
         # are reused immediately (warm in whatever cache level they touched).
         self._free: list[int] = list(range(n_blocks - 1, -1, -1))
         self._tables: dict[object, list[int]] = {}
+        # Prefix-cache residency: block id -> refcount (number of sequence
+        # tables currently containing the block). Keys are the cache-owned
+        # blocks; refcount 0 = unreferenced-but-resident (LRU-evictable).
+        self._cached: dict[int, int] = {}
+        self._cache = None        # attached RadixPrefixCache (LRU reclaim)
+        self._cow_jit = None      # compiled-once block copy (lazy)
 
     # -- allocator ----------------------------------------------------------
 
@@ -95,21 +125,47 @@ class KVPool:
     def n_used(self) -> int:
         return self.n_blocks - len(self._free)
 
+    @property
+    def n_cached(self) -> int:
+        """Blocks resident in the prefix cache (referenced or not)."""
+        return len(self._cached)
+
+    @property
+    def n_reclaimable(self) -> int:
+        """Cache-resident blocks with refcount 0 — what an LRU pass could
+        return to the free list right now. ``n_free + n_reclaimable`` is
+        the admission-visible headroom."""
+        return sum(1 for r in self._cached.values() if r == 0)
+
     def blocks_for(self, n_tokens: int) -> int:
-        return math.ceil(n_tokens / self.block_size)
+        return blocks_needed(n_tokens, self.block_size)
 
     def owned(self, seq_id) -> int:
         """Blocks currently owned by ``seq_id`` (0 if unknown)."""
         return len(self._tables.get(seq_id, ()))
 
-    def ensure(self, seq_id, n_tokens: int) -> bool:
+    def ensure(self, seq_id, n_tokens: int, *, adopt=(),
+               cow_src: int | None = None) -> bool:
         """Grow ``seq_id``'s table until it covers ``n_tokens`` tokens.
-        Returns False (allocating NOTHING) if the free list can't cover the
-        growth — all-or-nothing keeps admission/preemption decisions clean.
+        Returns False (allocating NOTHING, adopting NOTHING) if the free
+        list — after an LRU reclaim through the attached prefix cache —
+        can't cover the growth; all-or-nothing keeps admission/preemption
+        decisions clean.
+
+        ``adopt`` (admission-time only, the sequence must be NEW) is a
+        list of cache-resident block ids that become the table's prefix by
+        REFERENCE: each is increfed, none is allocated, and the sequence
+        must never write into them (the engine starts prefill past the
+        adopted tokens). ``cow_src`` names one more cache-resident block
+        whose prefix only partially matches: it is adopted by COPY-ON-
+        WRITE — a fresh private block is drawn from the free list, the
+        source block's K/V rows are copied on device, and the sequence may
+        then overwrite the divergent tail of the COPY.
 
         Fault site ``pool.ensure``: an installed ``FaultPlan`` may raise
         ``TransientFault`` here (before any mutation, so the allocator
-        state is untouched — callers retry or degrade).
+        state — including every cache refcount — is untouched; callers
+        retry or degrade).
         """
         if _faults._PLAN is not None:
             _faults.fire("pool.ensure")
@@ -117,34 +173,134 @@ class KVPool:
             raise ValueError(f"sequence length {n_tokens} exceeds pool "
                              f"max_seq_len {self.max_seq_len}")
         table = self._tables.get(seq_id)
-        need = self.blocks_for(n_tokens) - (len(table) if table else 0)
-        if need <= 0:
+        adopt = list(adopt)
+        adopting = bool(adopt) or cow_src is not None
+        if adopting and table is not None:
+            raise ValueError(
+                f"cache adoption for {seq_id!r} is admission-time only: "
+                f"the sequence already owns a table")
+        for b in adopt + ([cow_src] if cow_src is not None else []):
+            if b not in self._cached:
+                raise KeyError(f"adopting block {b} that is not "
+                               f"cache-resident")
+        n_cow = 1 if cow_src is not None else 0
+        have = (len(table) if table is not None
+                else len(adopt) + n_cow)
+        need = self.blocks_for(n_tokens) - have   # fresh private blocks
+        if adopting and need < 0:
+            raise ValueError("adopted prefix longer than the sequence")
+        draw = need + n_cow                       # drawn from the free list
+        if draw <= 0 and not adopting:
             return True
-        if need > len(self._free):
+        if draw > len(self._free) and self._cache is not None:
+            # LRU reclaim: evict unreferenced cached blocks — but never
+            # the ones this very call is about to adopt.
+            pinned = frozenset(adopt)
+            if cow_src is not None:
+                pinned |= {cow_src}
+            self._cache.evict(draw - len(self._free), exclude=pinned)
+        if draw > len(self._free):
             # All-or-nothing, including the table entry itself: a rejected
             # brand-new sequence must not leave an empty table behind (an
             # empty table is indistinguishable from a released-then-
-            # resurrected ghost; check_invariants flags both).
+            # resurrected ghost; check_invariants flags both). Refcounts
+            # are equally untouched — adoption never half-happens.
             return False
+        new_blocks: list[int] = []
+        if cow_src is not None:
+            dst = self._free.pop()
+            self._copy_block_device(cow_src, dst)
+            new_blocks.append(dst)
+        new_blocks.extend(self._free.pop() for _ in range(need))
         if table is None:
-            table = self._tables[seq_id] = []
-        table.extend(self._free.pop() for _ in range(need))
+            for b in adopt:
+                self._cached[b] += 1
+            table = self._tables[seq_id] = list(adopt)
+        table.extend(new_blocks)
         return True
 
     def release(self, seq_id) -> None:
-        """Return all of ``seq_id``'s blocks to the free list.
+        """Return ``seq_id``'s PRIVATE blocks to the free list and decref
+        its cache-resident (adopted or promoted) ones — those stay
+        resident for the next prefix match; an LRU pass frees them later.
 
         Unknown (never-ensured or already-released) ``seq_id`` raises —
         the silent no-op it used to be masked double-release bugs, and a
         later ``ensure()`` of the same id would resurrect a stale table
-        over freshly-allocated blocks with unrelated KV contents."""
+        over freshly-allocated blocks with unrelated KV contents. The
+        raise-before-mutate ordering also makes the quarantine path safe:
+        a double release can never double-decrement a shared refcount."""
         table = self._tables.pop(seq_id, None)
         if table is None:
             raise KeyError(
                 f"release of unknown seq_id {seq_id!r}: never allocated or "
                 f"already released (double release?)")
         for b in reversed(table):
-            self._free.append(b)
+            r = self._cached.get(b)
+            if r is None:
+                self._free.append(b)
+            else:
+                assert r > 0, f"cached block {b} refcount underflow"
+                self._cached[b] = r - 1
+
+    # -- prefix-cache residency (serving/prefix_cache.py drives these) ------
+
+    def attach_cache(self, cache) -> None:
+        """Register the prefix cache as this pool's LRU reclaim provider
+        (``ensure`` calls ``cache.evict`` when the free list runs short).
+        One cache per pool; pass None to detach."""
+        if cache is not None and self._cache is not None:
+            raise RuntimeError("pool already has an attached prefix cache")
+        self._cache = cache
+
+    def is_cached(self, block: int) -> bool:
+        return block in self._cached
+
+    def refs(self, block: int) -> int:
+        """Refcount of a cache-resident block (KeyError if not cached)."""
+        return self._cached[block]
+
+    def promote_to_cached(self, seq_id, block: int) -> None:
+        """Transfer one of ``seq_id``'s PRIVATE blocks into cache
+        residency (called by ``RadixPrefixCache.insert`` when a finished
+        sequence contributes a new chunk). The block stays in the table —
+        its refcount starts at 1 and drops to 0 at the table's release."""
+        table = self._tables.get(seq_id)
+        if table is None or block not in table:
+            raise KeyError(f"promote of block {block} not owned by "
+                           f"{seq_id!r}")
+        if block in self._cached:
+            raise ValueError(f"block {block} is already cache-resident")
+        self._cached[block] = 1
+
+    def uncache(self, block: int) -> None:
+        """Cache eviction endpoint: drop residency and free the block.
+        Only legal for UNREFERENCED cached blocks — evicting under a live
+        reader would hand its KV to the next allocator customer."""
+        r = self._cached.get(block)
+        if r is None:
+            raise KeyError(f"uncache of non-resident block {block}")
+        if r:
+            raise ValueError(f"uncache of block {block} with {r} live "
+                             f"references")
+        del self._cached[block]
+        self._free.append(block)
+
+    def _copy_block_device(self, src: int, dst: int) -> None:
+        """Copy-on-write kernel: duplicate block ``src``'s K/V rows (every
+        layer) into ``dst`` on device. Compiled ONCE per pool — src/dst are
+        traced scalars, so CoW churn never retraces — with both pool arrays
+        donated (the copy is in-place for HBM accounting, like the steps)."""
+        if self._cow_jit is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def cow(k, v, s, d):
+                return (k.at[:, d].set(k[:, s]), v.at[:, d].set(v[:, s]))
+
+            self._cow_jit = cow
+        st = self.state
+        k, v = self._cow_jit(st.k, st.v, jnp.asarray(src, jnp.int32),
+                             jnp.asarray(dst, jnp.int32))
+        self.state = PagedKVState(k=k, v=v)
 
     def fragmentation(self) -> dict:
         """Free-list fragmentation stats for the perf flight recorder:
@@ -166,7 +322,8 @@ class KVPool:
             prev = b
         frag = 0.0 if not free else 1.0 - longest / len(free)
         return {"free_blocks": len(free), "largest_free_run": longest,
-                "frag_frac": round(frag, 4)}
+                "frag_frac": round(frag, 4),
+                "cached_blocks": len(self._cached)}
 
     def table(self, seq_id) -> list[int]:
         return list(self._tables.get(seq_id, ()))
@@ -174,25 +331,47 @@ class KVPool:
     def padded_tables(self, seq_ids) -> np.ndarray:
         """(len(seq_ids), max_blocks_per_seq) int32 — slot-ordered block
         tables, zero-padded (None entries = empty slots), the fixed-shape
-        operand the compiled step consumes."""
+        operand the compiled step consumes.
+
+        An UNKNOWN non-None seq_id raises ``KeyError`` (mirroring the
+        ``release`` hardening): the all-zero row it used to emit silently
+        is indistinguishable from a real table pointing at block 0, so a
+        bookkeeping bug upstream would read another sequence's KV instead
+        of crashing."""
         out = np.zeros((len(seq_ids), self.max_blocks_per_seq), np.int32)
         for row, sid in enumerate(seq_ids):
             if sid is None:
                 continue
-            t = self._tables.get(sid, ())
+            t = self._tables.get(sid)
+            if t is None:
+                raise KeyError(
+                    f"padded_tables for unknown seq_id {sid!r}: never "
+                    f"allocated or already released")
             out[row, :len(t)] = t
         return out
 
     def check_invariants(self) -> None:
-        """Allocator soundness: free + owned partition the pool exactly,
-        and no sequence holds an EMPTY table (an empty table is a stale
-        ghost — released or never funded — that a later ``ensure()`` would
-        silently resurrect)."""
+        """Allocator soundness: free ∪ private-owned ∪ cached partition
+        the pool EXACTLY — private blocks sit in exactly one table, each
+        cached block's refcount equals its table-occurrence count, nothing
+        is simultaneously free and resident — and no sequence holds an
+        EMPTY table (an empty table is a stale ghost — released or never
+        funded — that a later ``ensure()`` would silently resurrect)."""
         owned = [b for t in self._tables.values() for b in t]
-        assert len(set(owned)) == len(owned), "block owned twice"
+        occ = collections.Counter(owned)
+        private = [b for b in owned if b not in self._cached]
+        assert len(set(private)) == len(private), "private block owned twice"
         assert len(set(self._free)) == len(self._free), "free list duplicate"
-        assert not (set(owned) & set(self._free)), "block both free and owned"
-        assert len(owned) + len(self._free) == self.n_blocks, "blocks leaked"
-        assert all(0 <= b < self.n_blocks for b in owned + self._free)
+        free_set = set(self._free)
+        assert not (set(owned) & free_set), "block both free and owned"
+        assert not (set(self._cached) & free_set), "block both free and cached"
+        for b, r in self._cached.items():
+            assert occ.get(b, 0) == r, (
+                f"cached block {b}: refcount {r} != {occ.get(b, 0)} table "
+                f"occurrences")
+        assert (len(private) + len(self._cached) + len(self._free)
+                == self.n_blocks), "blocks leaked"
+        assert all(0 <= b < self.n_blocks
+                   for b in owned + self._free + list(self._cached))
         empty = [sid for sid, t in self._tables.items() if not t]
         assert not empty, f"empty (stale) tables for seq_ids {empty!r}"

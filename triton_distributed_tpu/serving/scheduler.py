@@ -13,7 +13,10 @@ Policies (deliberately simple, swappable):
              values jump the line.
   admission  admit the head request only if the KV pool can hold its WHOLE
              prompt plus one generated token right now (all blocks are
-             allocated at admission). No lookahead reservation for future
+             allocated at admission). With a prefix cache attached, fully
+             cached blocks are adopted by reference instead of allocated,
+             so admission charges only the uncached suffix (the
+             ``match_len`` probe). No lookahead reservation for future
              decode growth — that's what preemption is for.
   preemption ``select_victim``: lowest priority first, latest-admitted
              first among equals (LIFO — the youngest request has the least
@@ -36,10 +39,12 @@ Policies (deliberately simple, swappable):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import heapq
 import itertools
 
 from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.serving.kv_pool import blocks_needed
 
 
 @dataclasses.dataclass
@@ -103,15 +108,54 @@ class Scheduler:
         return heapq.heappop(self._heap)[2]
 
     def admit(self, *, free_slots: int, free_blocks: int,
-              block_size: int) -> list[Request]:
+              block_size: int | None = None, blocks_for=None,
+              match_len=None) -> list[Request]:
         """Head-of-line admission: pop requests while a slot is free and the
-        pool can hold prompt+1 tokens. Stops at the first request that does
-        not fit (no skip-ahead — skipping would starve big requests)."""
+        pool can hold the request's UNCACHED suffix plus one generated
+        token right now. Stops at the first request that does not fit (no
+        skip-ahead — skipping would starve big requests).
+
+        Block accounting is delegated so admission and allocation can never
+        disagree on rounding: pass ``blocks_for`` as the ``KVPool`` itself
+        (or any ``n_tokens -> n_blocks`` callable); ``block_size`` alone
+        keeps the legacy signature, routing through the same
+        ``kv_pool.blocks_needed`` the pool uses.
+
+        ``match_len`` (a ``Request -> int`` probe, usually
+        ``RadixPrefixCache.match_len`` over the request's context) is the
+        prefix-cache discount: FULL cached blocks are adopted by reference
+        rather than allocated, so a mostly-cached request is charged only
+        ``matched // block_size`` fewer blocks — a CoW tail block still
+        costs one fresh block, so partial matches discount nothing. The
+        probe is advisory (eviction between probe and ``ensure`` can
+        shrink the real match); the engine re-matches at adoption time and
+        requeues on a genuine shortfall."""
+        if blocks_for is None:
+            if block_size is None:
+                raise TypeError("admit() requires blocks_for (a KVPool or "
+                                "n_tokens->n_blocks callable) or block_size")
+            bf = functools.partial(blocks_needed, block_size=block_size)
+            bs = block_size
+        elif callable(blocks_for):
+            bf = blocks_for
+            bs = block_size
+        else:                          # duck-typed KVPool
+            bf = blocks_for.blocks_for
+            bs = blocks_for.block_size
+        if match_len is not None and bs is None:
+            raise TypeError("match_len discounting needs block_size (or a "
+                            "pool-shaped blocks_for)")
         admitted: list[Request] = []
         budget = free_blocks
         while len(admitted) < free_slots and self._heap:
             head = self.peek()
-            need = -(-(head.context_len + 1) // block_size)  # ceil
+            need = bf(head.context_len + 1)
+            if match_len is not None:
+                # Engine caps adoption at context_len-1 (at least one token
+                # must be recomputed for first-token logits) — mirror it.
+                matched = min(int(match_len(head)),
+                              max(head.context_len - 1, 0))
+                need -= matched // bs
             if need > budget:
                 break
             budget -= need
