@@ -674,6 +674,121 @@ def _bench_serve_prefix() -> dict:
     }
 
 
+def _bench_serve_slo() -> dict:
+    """The ``--serve --slo`` arm: cost and sanity of the always-on serving
+    telemetry (windowed metrics + SLO engine + blackbox + tail-sampled
+    request traces) vs the same engine with all of it off.
+
+    Two BatchEngines over one model, same workload, interleaved timed
+    rounds so drift cancels:
+
+        obs_overhead_frac = (t_on - t_off) / t_off
+
+    is the headline metric (lower-better override in perfdb). On real
+    hardware the ≤5% contract is ENFORCED; off-TPU the fraction is
+    recorded but not gated (CPU step time is Python dispatch, which
+    overstates host-side bookkeeping). Asserted on every backend: greedy
+    output bit-identical between the two engines, zero retraces (the
+    telemetry is pure host data), zero SLO breaches under the healthy run
+    (thresholds are generous), and every objective reading OK — the
+    per-objective states land in extras as ``slo_state_<name>`` levels
+    (0=OK, 1=WARN, 2=BREACH)."""
+    import time as _time
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.slo import STATE_LEVEL, default_serving_slo
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    kw = dict(n_slots=4, n_blocks=48, block_size=16, prefill_chunk=32)
+    be_on = BatchEngine(engine, **kw)     # telemetry defaults: all on
+    be_off = BatchEngine(engine, **kw, windowed_metrics=False,
+                         blackbox=False, tail_sampling=False)
+    slo = be_on.attach_slo(
+        default_serving_slo(ttft_p99_s=30.0, tbt_p99_s=5.0,
+                            error_rate=0.5),
+        eval_interval_s=0.05)
+
+    rng = np.random.default_rng(0)
+    n_req, gen = 16, 8
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(24, 49))).tolist()
+               for _ in range(n_req)]
+
+    def run_pass(be, tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        t0 = _time.perf_counter()
+        done = be.run(max_steps=5000)
+        dt = _time.perf_counter() - t0
+        return [done[r] for r in rids], dt
+
+    out_on, _ = run_pass(be_on, "warm-on")     # compiles off the clock
+    out_off, _ = run_pass(be_off, "warm-off")
+    if out_on != out_off:
+        raise RuntimeError("always-on telemetry changed greedy output")
+
+    rounds = 6 if on_tpu else 3
+    t_on, t_off = [], []
+    for r in range(rounds):                    # interleaved: drift cancels
+        _, dt = run_pass(be_off, f"r{r}-off")
+        t_off.append(dt)
+        _, dt = run_pass(be_on, f"r{r}-on")
+        t_on.append(dt)
+    s_off, s_on = min(t_off), min(t_on)
+    frac = (s_on - s_off) / s_off
+
+    for be, tag in ((be_on, "on"), (be_off, "off")):
+        retr = be.trace_counts["decode"] + be.trace_counts["prefill"] - 2
+        if retr:
+            raise RuntimeError(f"telemetry-{tag} engine retraced {retr}x")
+        be.pool.check_invariants()
+    verdicts = slo.verdicts()
+    if slo.n_breaches or any(v != "OK" for v in verdicts.values()):
+        raise RuntimeError(f"healthy run tripped the SLO: {verdicts} "
+                           f"({slo.n_breaches} breaches)")
+    snap = be_on.stats_snapshot()              # exercised, must be JSON-able
+    json.dumps(snap, default=str)
+    ok = (frac <= 0.05) or not on_tpu
+    extras = {
+        "serve_slo_off_s": round(s_off, 6),
+        "serve_slo_on_s": round(s_on, 6),
+        "obs_overhead_ok": ok,
+        "obs_overhead_gated": on_tpu,
+        "serve_slo_bit_identical": True,
+        "serve_slo_retraces": 0,
+        "slo_breaches": int(slo.n_breaches),
+        "slo_evaluations": int(slo.n_evaluations),
+        "trace_dropped_spans": int(snap["trace_dropped_spans"]),
+        "blackbox_dropped": int(snap["blackbox"]["dropped"]),
+    }
+    for name, state in verdicts.items():
+        extras[f"slo_state_{name}"] = STATE_LEVEL[state]
+    if not ok:
+        raise RuntimeError(
+            f"always-on telemetry overhead {frac:.1%} exceeds the 5% "
+            f"step-time budget (off={s_off:.4f}s on={s_on:.4f}s)")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "obs_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -722,18 +837,24 @@ def main():
     # timing-sensitive number, and it compares two passes of the same
     # process against each other).
     if "--serve" in sys.argv:
+        # --serve --slo: always-on telemetry overhead arm; plain --serve:
+        # the prefix-cache arm. Same placement rationale for both.
+        with_slo = "--slo" in sys.argv
+        metric = "obs_overhead_frac" if with_slo else "prefix_hit_rate"
         try:
-            result = _bench_serve_prefix()
+            result = _bench_serve_slo() if with_slo \
+                else _bench_serve_prefix()
         except Exception as e:  # noqa: BLE001
             result = {
                 "backend": "error",
-                "metric": "prefix_hit_rate",
+                "metric": metric,
                 "value": None,
                 "unit": "frac",
                 "error": f"{type(e).__name__}: {str(e)[:200]}",
             }
         print(json.dumps(result))
-        _record_perfdb(result, perfdb_path, suite="serve_prefix")
+        _record_perfdb(result, perfdb_path,
+                       suite="serve_slo" if with_slo else "serve_prefix")
         return
 
     # Backend probe FIRST: everything below (compile cache, device queries)

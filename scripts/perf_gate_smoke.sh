@@ -20,7 +20,15 @@
 #                                           radix cache (hit rate > 0,
 #                                           bit-identity and 0 retraces
 #                                           hard-checked anywhere)
-#   6. tools/perf_gate.py --db ...       -> compare newest vs history,
+#   6. python bench.py --serve --slo     -> always-on observability arm:
+#                                           obs-on vs obs-off serving wall
+#                                           time (obs_overhead_frac,
+#                                           lower-better; <= 5% enforced
+#                                           where the arm gates, i.e. on
+#                                           TPU) with SLO verdicts, bit-
+#                                           identity and 0 retraces hard-
+#                                           checked anywhere
+#   7. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -128,6 +136,34 @@ assert ex.get("ttft_warm_over_cold", 99.0) < 1.0, ex
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_slo run $i/2" >&2
+  python bench.py --serve --slo --perfdb "$DB" \
+    > "$WORKDIR/serve_slo_out.$i.json"
+  python - "$WORKDIR/serve_slo_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+assert obj["value"] is not None, obj
+ex = obj.get("extras", {})
+# The acceptance bar (ISSUE 10): always-on telemetry must not change the
+# greedy output, must not retrace, and a healthy run must end with every
+# SLO objective OK (no breaches). The <=5% overhead budget binds wherever
+# the arm gates (real hardware — on the CPU interpreter the serving loop
+# is Python dispatch, so the arm records the fraction but marks it
+# ungated).
+assert ex.get("serve_slo_bit_identical") is True, ex
+assert ex.get("serve_slo_retraces") == 0, ex
+assert ex.get("slo_breaches") == 0, ex
+assert ex.get("slo_evaluations", 0) > 0, ex
+assert ex.get("obs_overhead_ok") is True, ex
+if ex.get("obs_overhead_gated"):
+    assert obj["value"] <= 0.05, obj["value"]
+EOF
+done
+
 echo "perf_gate_smoke: gating serve_smoke suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_smoke \
   --tolerance "$TOL" --report "$WORKDIR/serve_report.md"
@@ -147,5 +183,9 @@ python tools/perf_gate.py --db "$DB" --suite probe_overhead \
 echo "perf_gate_smoke: gating serve_prefix suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_prefix \
   --tolerance "$TOL" --report "$WORKDIR/serve_prefix_report.md"
+
+echo "perf_gate_smoke: gating serve_slo suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_slo \
+  --tolerance "$TOL" --report "$WORKDIR/serve_slo_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

@@ -31,13 +31,18 @@ import numpy as np
 
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
-         perfdb_path: str | None = None) -> dict:
+         perfdb_path: str | None = None, slo: bool = False,
+         stats_jsonl: str | None = None) -> dict:
     """Run the load, return the metrics dict. Raises RuntimeError on any
     retrace beyond the first compile of each step kind; with ``chaos``,
     also on any violation of the graceful-degradation contract.
     ``perfdb_path`` appends the run's TTFT/TBT/throughput sample to the
     perf flight recorder's run database (obs/perfdb.py) so
-    ``tools/perf_gate.py`` can gate serving latency across PRs."""
+    ``tools/perf_gate.py`` can gate serving latency across PRs.
+    ``slo`` attaches the stock serving SLO set (generous thresholds) and
+    reports its verdicts in the result; ``stats_jsonl`` streams live
+    ``stats_snapshot()`` lines to that path (``tools/serve_top.py`` tails
+    it)."""
     import contextlib
 
     import jax
@@ -66,6 +71,18 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
                      block_size=4, prefill_chunk=8,
                      retry=RetryPolicy(retries=6, base_delay_s=0.001)
                      if chaos else None)
+    slo_engine = None
+    if slo:
+        # Generous thresholds: the smoke asserts the machinery evaluates
+        # and stays healthy, not that CI hardware hits production latency.
+        from triton_distributed_tpu.obs.slo import default_serving_slo
+
+        slo_engine = be.attach_slo(
+            default_serving_slo(ttft_p99_s=30.0, tbt_p99_s=5.0,
+                                error_rate=0.9 if chaos else 0.5),
+            eval_interval_s=0.25)
+    if stats_jsonl:
+        be.stream_stats(stats_jsonl, interval_s=0.5)
 
     plan_ctx = contextlib.nullcontext()
     if chaos:
@@ -116,6 +133,11 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     # AG and RS) plus whatever the serve run itself put in the ledger.
     m["comm_ledger"] = comm_ledger.snapshot()
     m["ledger_selfcheck"] = comm_ledger.selfcheck()
+    if slo_engine is not None:
+        m["slo_verdicts"] = slo_engine.verdicts()
+        m["slo_breaches"] = slo_engine.n_breaches
+        if not slo_engine.n_evaluations:
+            raise RuntimeError("SLO attached but never evaluated")
     be.pool.check_invariants()
     # After drain every block is either free or parked in the prefix cache
     # with zero references (reclaimable). Anything else is a leak.
@@ -176,10 +198,17 @@ if __name__ == "__main__":
                     help="append this run's TTFT/TBT/throughput sample to "
                          "the PerfDB JSONL at this path (tools/perf_gate.py "
                          "gates on it)")
+    ap.add_argument("--slo", action="store_true",
+                    help="attach the stock serving SLO set and report its "
+                         "verdicts")
+    ap.add_argument("--stats-jsonl", default=None,
+                    help="stream live stats_snapshot() JSON lines here "
+                         "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
         metrics = main(args.duration, rate_hz=args.rate, seed=args.seed,
-                       chaos=args.chaos, perfdb_path=args.perfdb)
+                       chaos=args.chaos, perfdb_path=args.perfdb,
+                       slo=args.slo, stats_jsonl=args.stats_jsonl)
     except RuntimeError as e:
         print(f"FAIL: {e}")
         raise SystemExit(1)

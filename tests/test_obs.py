@@ -19,6 +19,7 @@ from triton_distributed_tpu.obs.metrics import (
     Metrics,
     parse_prometheus,
 )
+from triton_distributed_tpu.obs.window import DEFAULT_BOUNDS
 from triton_distributed_tpu.runtime import perf_model as pm
 
 
@@ -121,6 +122,36 @@ def test_ring_buffer_bounded():
         t.instant(f"e{i}")
     assert len(t) == 8
     assert t.records[0].name == "e42"      # oldest evicted
+    # Evictions are COUNTED, never silent, and reset() clears the counter.
+    assert t.dropped == 42
+    t.reset()
+    assert t.dropped == 0 and len(t) == 0
+
+
+def test_dropped_spans_surface_in_chrome_export(tmp_path):
+    t = trace.Tracer(capacity=4)
+    t.enable()
+    for i in range(10):
+        t.instant(f"e{i}")
+    path = t.export_chrome_trace(str(tmp_path))
+    meta = json.loads(open(path).read())["metadata"]
+    # A truncated trace announces itself: the export metadata carries both
+    # how much survived and how much the ring wrap evicted.
+    assert meta["recorded_spans"] == 4
+    assert meta["dropped_spans"] == 6
+
+
+def test_module_level_dropped_spans_counter():
+    # The serving gauge reads the module-level counter; don't resize the
+    # process-global ring (other tests share it) — the default 64k ring
+    # simply shouldn't wrap here, so the counter stays 0 and resets clean.
+    trace.reset()
+    assert trace.dropped_spans() == 0
+    with trace.tracing():
+        trace.instant("d0")
+    assert trace.dropped_spans() == 0
+    assert trace.get_tracer().dropped == trace.dropped_spans()
+    trace.reset()
 
 
 def test_module_level_tracing_context_restores_state():
@@ -213,13 +244,76 @@ def test_prometheus_roundtrip():
     m.observe("ttft_s", 0.75)
     text = m.to_prometheus()
     assert "# TYPE requests_total counter" in text
-    assert '# TYPE ttft_s summary' in text
+    assert "# TYPE ttft_s histogram" in text
     parsed = parse_prometheus(text)
     assert parsed["requests_total{kind=prefill}"] == 4.0
     assert parsed["queue_depth"] == 2.0
     assert parsed["ttft_s_count"] == 2.0
     assert parsed["ttft_s_sum"] == 1.0
     assert parsed["ttft_s{quantile=0.5}"] == 0.25
+    # Real-histogram exposition: cumulative _bucket{le=...} series over the
+    # fixed bounds, closed by the +Inf bucket == total count.
+    assert parsed["ttft_s_bucket{le=+Inf}"] == 2.0
+    bucket_vals = [v for k, v in parsed.items()
+                   if k.startswith("ttft_s_bucket{")]
+    assert len(bucket_vals) == len(DEFAULT_BOUNDS) + 1
+    assert bucket_vals == sorted(bucket_vals)        # cumulative
+    # 0.25 and 0.75 both land below 1.0: the le=1 bucket already sees both.
+    assert parsed["ttft_s_bucket{le=1}"] == 2.0
+
+
+def test_prometheus_bucket_counts_match_histogram():
+    m = Metrics()
+    vals = [0.0005, 0.003, 0.003, 0.02, 0.9, 50.0, 1e4]   # incl. overflow
+    for v in vals:
+        m.observe("lat_s", v)
+    parsed = parse_prometheus(m.to_prometheus())
+    h = m.histograms["lat_s"]
+    # Every finite cumulative bucket agrees with the histogram's own
+    # cumulative_buckets(); +Inf is the total (overflow included).
+    for le, cum in h.cumulative_buckets():
+        assert parsed[f"lat_s_bucket{{le={le:g}}}"] == float(cum)
+    assert parsed["lat_s_bucket{le=+Inf}"] == float(len(vals))
+    assert parsed["lat_s_sum"] == pytest.approx(sum(vals))
+
+
+def test_histogram_bounded_reservoir_and_exact_accumulators():
+    h = Histogram(max_samples=64)
+    n = 10_000
+    for i in range(n):
+        h.observe(float(i))
+    # The reservoir is bounded at max_samples (most recent kept)...
+    assert len(h.samples) == 64
+    assert list(h.samples)[0] == float(n - 64)
+    # ...while count/sum/mean/min/max stay EXACT via running accumulators.
+    assert h.count == n
+    assert h.sum == pytest.approx(n * (n - 1) / 2.0)
+    assert h.mean == pytest.approx((n - 1) / 2.0)
+    assert h.min == 0.0 and h.max == float(n - 1)
+    # Percentiles read the trailing reservoir only.
+    assert h.percentile(0) == float(n - 64)
+    assert h.tail(3) == [float(n - 3), float(n - 2), float(n - 1)]
+
+
+def test_to_prometheus_cost_independent_of_observation_count():
+    """Scrape cost regression: exposition reads running accumulators and
+    fixed bucket arrays, so a registry that has absorbed 100k observations
+    must scrape in roughly the same time as one that absorbed 100 — a
+    linear full-list scan per scrape would blow this bound immediately."""
+    import timeit
+
+    small, big = Metrics(), Metrics()
+    for i in range(100):
+        small.observe("lat_s", i * 1e-3)
+    for i in range(100_000):
+        big.observe("lat_s", i * 1e-3)
+    k = 20
+    t_small = timeit.timeit(small.to_prometheus, number=k)
+    t_big = timeit.timeit(big.to_prometheus, number=k)
+    # Bounded-reservoir sorts differ (100 vs 8192 retained samples) but the
+    # cost must not scale with the 1000x observation-count gap. Generous
+    # slack for shared-CI noise.
+    assert t_big <= 10.0 * t_small + 0.2, (t_small, t_big)
 
 
 # ---------------------------------------------------------------------------

@@ -149,6 +149,31 @@ def test_compare_signed_delta_and_tolerance():
     assert by["x_ms"].delta_frac < 0 and by["tok_per_s"].delta_frac < 0
 
 
+def test_compare_overhead_frac_absolute_slack():
+    # Overhead fractions are near-zero cost ratios: 2% vs 4% is "+90%"
+    # relative but both sit deep inside the 5% budget — unchanged. Beyond
+    # the absolute slack the normal relative gate applies again.
+    base = [pdb.RunRecord("b", 1.0, "bench", dict(FP),
+                          {"obs_overhead_frac": 0.022})]
+    head = [pdb.RunRecord("h", 2.0, "bench", dict(FP),
+                          {"obs_overhead_frac": 0.042})]
+    by = {v.metric: v for v in pdb.compare(base, head, tolerance=0.5)}
+    assert by["obs_overhead_frac"].status == "unchanged"
+    assert by["obs_overhead_frac"].delta_frac == pytest.approx(0.909, abs=0.01)
+    # A genuine blow-up (2% -> 20%) exceeds the slack and still regresses.
+    bad = [pdb.RunRecord("h2", 3.0, "bench", dict(FP),
+                         {"obs_overhead_frac": 0.20})]
+    by = {v.metric: v for v in pdb.compare(base, bad, tolerance=0.5)}
+    assert by["obs_overhead_frac"].status == "regressed"
+    # Zero-base jitter (0.0 -> 0.03) must not trip the inf-delta path.
+    zb = [pdb.RunRecord("b0", 1.0, "bench", dict(FP),
+                        {"probe_overhead_frac": 0.0})]
+    zh = [pdb.RunRecord("h0", 2.0, "bench", dict(FP),
+                        {"probe_overhead_frac": 0.03})]
+    by = {v.metric: v for v in pdb.compare(zb, zh, tolerance=0.5)}
+    assert by["probe_overhead_frac"].status == "unchanged"
+
+
 def test_compare_new_gone_and_unknown_never_regress():
     base = [pdb.RunRecord("b", 1.0, "bench", dict(FP),
                           {"old_ms": 1.0, "mystery_count": 5.0})]

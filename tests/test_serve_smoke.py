@@ -46,6 +46,29 @@ def test_serve_smoke_short():
         assert entry["calls"] + entry["traced_calls"] >= 1
 
 
+def test_serve_smoke_slo_and_stats_feed(tmp_path):
+    """--slo attaches the stock objective set (generous thresholds: a
+    healthy short run must end all-OK with zero breaches) and
+    --stats-jsonl streams the serve_top feed; both ride the same run."""
+    feed = tmp_path / "stats.jsonl"
+    m = _load().main(3.0, rate_hz=6.0, seed=0, slo=True,
+                     stats_jsonl=str(feed))
+    assert m["requests_completed"] == m["requests_submitted"] > 0
+    assert m["slo_verdicts"] == {"ttft_p99": "OK", "tbt_p99": "OK",
+                                 "error_rate": "OK"}
+    assert m["slo_breaches"] == 0
+    lines = feed.read_text().strip().splitlines()
+    assert lines, "stats stream wrote nothing"
+    import json
+
+    from tools import serve_top
+
+    snap = json.loads(lines[-1])
+    assert "windows" in snap and "counters" in snap
+    frame = serve_top.render(snap)
+    assert "slo" in frame and "telemetry" in frame
+
+
 def test_serve_smoke_chaos():
     """The --chaos mode's graceful-degradation contract: the engine rides
     out injected transient errors and NaN-poisoned rows, finishing with

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""serve_top: live terminal dashboard over the serving engine's stats feed.
+
+``BatchEngine.stream_stats(path)`` appends one ``stats_snapshot()`` JSON
+line per interval; this tool tails that file and renders the latest frame
+as a compact top(1)-style view — slot occupancy, KV-pool pressure,
+trailing-window TTFT/TBT/queue-wait percentiles (last 10 s and last
+5 min), prefix-cache hit rate, SLO verdicts, and the bounded-telemetry
+drop counters (blackbox evictions, tracer ring wraps, sampler drops) that
+say how much history the flight recorders currently hold.
+
+    python tools/serve_top.py --stats-jsonl /tmp/serve_stats.jsonl
+    python tools/serve_top.py --stats-jsonl ... --once      # one frame
+    python tools/serve_top.py --demo                        # no engine
+
+Pure consumer: reads the JSONL feed only, shares no process with the
+engine, so it can run over a file on a shared filesystem while the pod
+serves. ``render()`` is a pure snapshot->str function (tested directly).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+_BAR_W = 24
+
+
+def _bar(frac: float, width: int = _BAR_W) -> str:
+    frac = min(1.0, max(0.0, frac))
+    n = int(round(frac * width))
+    return "[" + "#" * n + "." * (width - n) + "]"
+
+
+def _ms(v) -> str:
+    return f"{float(v) * 1e3:8.1f}" if v is not None else "       -"
+
+
+def _fmt_window(label: str, w: dict) -> str:
+    """One latency row: ``ttft 10s  p50 .. p90 .. p99 .. (n=..)``."""
+    return (f"    {label:<14} p50 {_ms(w.get('p50'))}  "
+            f"p90 {_ms(w.get('p90'))}  p99 {_ms(w.get('p99'))}  ms  "
+            f"(n={int(w.get('count', 0))})")
+
+
+_SLO_MARK = {"OK": " ok ", "WARN": "WARN", "BREACH": "BRCH"}
+
+
+def render(snap: dict) -> str:
+    """Render one ``BatchEngine.stats_snapshot()`` dict as a text frame."""
+    lines: list[str] = []
+    slots = snap.get("slots", {})
+    active, total = slots.get("active", 0), max(1, slots.get("total", 1))
+    pool = snap.get("pool", {})
+    n_blocks = max(1, pool.get("n_blocks", 1))
+    used = pool.get("n_used", 0)
+    c = snap.get("counters", {})
+    lines.append(
+        f"serve_top  wall={snap.get('wall_time', 0.0):.1f}  "
+        f"queue={snap.get('queue_depth', 0)}")
+    lines.append(
+        f"  slots {_bar(active / total)} {active}/{total}    "
+        f"pool {_bar(used / n_blocks)} {used}/{n_blocks} used, "
+        f"{pool.get('n_free', 0)} free, {pool.get('n_cached', 0)} cached, "
+        f"{pool.get('n_reclaimable', 0)} reclaimable")
+    line = (f"  req admitted={int(c.get('requests_admitted', 0))} "
+            f"done={int(c.get('requests_completed', 0))} "
+            f"failed={int(c.get('requests_failed', 0))} "
+            f"preempt={int(c.get('preemptions', 0))} "
+            f"tokens={int(c.get('tokens_generated', 0))}")
+    if "prefix_hit_rate" in snap:
+        line += f"  prefix_hit={snap['prefix_hit_rate'] * 100:.1f}%"
+    lines.append(line)
+    windows = snap.get("windows", {})
+    for wlabel in ("10s", "5m"):
+        series = windows.get(wlabel, {})
+        if not series:
+            continue
+        lines.append(f"  last {wlabel}:")
+        for name in ("ttft_s", "tbt_s", "queue_wait_s"):
+            if name in series:
+                lines.append(_fmt_window(name[:-2], series[name]))
+    slo = snap.get("slo")
+    if slo:
+        states = " ".join(
+            f"{name}={_SLO_MARK.get(st, st)}"
+            for name, st in sorted(slo.get("states", {}).items()))
+        lines.append(f"  slo  {states}  breaches={slo.get('breaches', 0)}")
+    drops = []
+    bb = snap.get("blackbox")
+    if bb:
+        drops.append(f"blackbox {bb.get('len', 0)} held / "
+                     f"{bb.get('dropped', 0)} evicted")
+    if "trace_dropped_spans" in snap:
+        drops.append(f"trace {int(snap['trace_dropped_spans'])} dropped")
+    sam = snap.get("sampler")
+    if sam:
+        drops.append(f"sampler {sam.get('retained', 0)} kept "
+                     f"({sam.get('kept_tail', 0)} tail) / "
+                     f"{sam.get('dropped', 0)} dropped")
+    if drops:
+        lines.append("  telemetry  " + "   ".join(drops))
+    return "\n".join(lines) + "\n"
+
+
+def _demo_snapshot(i: int) -> dict:
+    """Synthesized frame for ``--demo`` (no engine required)."""
+    phase = i % 30
+    slow = phase >= 20
+    tbt = 0.18 if slow else 0.012
+    return {
+        "wall_time": 1e9 + i, "queue_depth": 3 if slow else 0,
+        "slots": {"active": 4 if slow else 2 + i % 3, "total": 4},
+        "pool": {"n_blocks": 64, "n_used": 40 + min(phase, 24), "n_free":
+                 max(0, 24 - phase), "n_cached": 10, "n_reclaimable": 8},
+        "counters": {"requests_admitted": 10 * i, "requests_completed":
+                     10 * i - 4, "requests_failed": i // 10,
+                     "preemptions": i // 5, "tokens_generated": 160 * i,
+                     "admission_backpressure": 0, "slo_breaches":
+                     1 if slow else 0},
+        "prefix_hit_rate": 0.42,
+        "windows": {"10s": {"ttft_s": {"count": 40, "p50": 0.05, "p90":
+                                       0.09, "p99": 0.2},
+                            "tbt_s": {"count": 600, "p50": tbt, "p90":
+                                      tbt * 1.5, "p99": tbt * 2.0}},
+                    "5m": {"ttft_s": {"count": 1200, "p50": 0.05, "p90":
+                                      0.09, "p99": 0.15},
+                           "tbt_s": {"count": 20000, "p50": 0.012,
+                                     "p90": 0.02, "p99": 0.05}}},
+        "slo": {"states": {"ttft_p99": "OK", "tbt_p99":
+                           "BREACH" if slow else "OK"},
+                "breaches": 1 if slow else 0},
+        "blackbox": {"len": 512, "recorded": 600 * i, "dropped":
+                     max(0, 600 * i - 512)},
+        "trace_dropped_spans": 0,
+        "sampler": {"retained": 12, "kept_tail": 3, "dropped": 900},
+    }
+
+
+def _last_snapshot(path: str) -> dict | None:
+    """Newest parseable JSON line of the stats feed (None when empty)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--stats-jsonl", default=None,
+                    help="stats feed written by BatchEngine.stream_stats")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="refresh period in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render the latest frame and exit")
+    ap.add_argument("--demo", action="store_true",
+                    help="render synthesized frames (no engine)")
+    args = ap.parse_args(argv)
+    if not args.demo and args.stats_jsonl is None:
+        ap.error("need --stats-jsonl PATH (or --demo)")
+
+    i = 0
+    while True:
+        if args.demo:
+            snap = _demo_snapshot(i)
+        else:
+            snap = _last_snapshot(args.stats_jsonl)
+        if snap is None:
+            frame = f"serve_top: waiting for {args.stats_jsonl} ...\n"
+        else:
+            frame = render(snap)
+        if args.once:
+            sys.stdout.write(frame)
+            return 0 if snap is not None else 1
+        # \x1b[H\x1b[2J = cursor home + clear: repaint in place like top(1).
+        sys.stdout.write("\x1b[H\x1b[2J" + frame)
+        sys.stdout.flush()
+        i += 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -17,6 +17,23 @@ One substrate, three views, threaded through every layer of the stack:
                    latency, fed by every collective entry point in
                    ``kernels/``. Near-zero-overhead no-op when disabled.
 
+Always-on serving telemetry (bounded, constant-memory — a serving loop
+runs for weeks):
+
+  obs.window       time-bucketed ring of fixed log-spaced value buckets:
+                   trailing-window ("last 10 s / 5 min") quantiles and
+                   violation fractions at memory constant in request
+                   count. ``Metrics(windowed=True)`` feeds it.
+  obs.slo          declarative SLO objectives (ttft_p99, tbt_p99, error
+                   rate, hit-rate floor) evaluated with fast+slow
+                   burn-rate windows -> OK/WARN/BREACH state machine;
+                   BREACH fires the resilience snapshot path.
+  obs.blackbox     flight recorder: bounded ring of structured serving
+                   lifecycle events, dumped whole into breach snapshots.
+  TailSampler      (obs.trace) per-request trace sampling that always
+                   keeps slow/errored requests plus a deterministic
+                   head-sampled fraction.
+
 Perf flight recorder (on top of the three views above):
 
   obs.roofline     joins the comm ledger with ``runtime/perf_model``
@@ -33,10 +50,14 @@ call site when off — the serving/bench hot paths carry the hooks
 permanently. Design note: docs/observability.md.
 """
 
+from triton_distributed_tpu.obs import blackbox  # noqa: F401
 from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
 from triton_distributed_tpu.obs import perfdb  # noqa: F401
 from triton_distributed_tpu.obs import roofline  # noqa: F401
+from triton_distributed_tpu.obs import slo  # noqa: F401
 from triton_distributed_tpu.obs import trace  # noqa: F401
+from triton_distributed_tpu.obs import window  # noqa: F401
+from triton_distributed_tpu.obs.blackbox import Blackbox  # noqa: F401
 from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
     CommLedger,
     LedgerEntry,
@@ -53,17 +74,30 @@ from triton_distributed_tpu.obs.metrics import (  # noqa: F401
     Metrics,
     parse_prometheus,
 )
+from triton_distributed_tpu.obs.slo import (  # noqa: F401
+    Objective,
+    SLOEngine,
+    default_serving_slo,
+)
 from triton_distributed_tpu.obs.trace import (  # noqa: F401
+    RequestTrace,
     SpanRecord,
+    TailSampler,
     Tracer,
     group_profile,
     merge_chrome_traces,
 )
+from triton_distributed_tpu.obs.window import (  # noqa: F401
+    WindowRing,
+    WindowStats,
+)
 
 __all__ = [
-    "CommLedger", "FingerprintMismatch", "Histogram", "LedgerEntry",
-    "Metrics", "PerfDB", "RooflineRecord", "RunRecord", "SpanRecord",
-    "Tracer", "Verdict", "comm_ledger", "group_profile",
+    "Blackbox", "CommLedger", "FingerprintMismatch", "Histogram",
+    "LedgerEntry", "Metrics", "Objective", "PerfDB", "RequestTrace",
+    "RooflineRecord", "RunRecord", "SLOEngine", "SpanRecord",
+    "TailSampler", "Tracer", "Verdict", "WindowRing", "WindowStats",
+    "blackbox", "comm_ledger", "default_serving_slo", "group_profile",
     "merge_chrome_traces", "parse_prometheus", "perfdb", "roofline",
-    "trace",
+    "slo", "trace", "window",
 ]

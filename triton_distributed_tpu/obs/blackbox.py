@@ -1,0 +1,82 @@
+"""Black-box recorder: a bounded ring of structured serving events.
+
+The forensic question a latency breach raises is never "what is the p99"
+— the SLO engine already knows — but "what HAPPENED in the 30 seconds
+before it": which requests were admitted, who got preempted, which fault
+fired, when the state machine started warning. The tracer answers that
+for spans at microsecond granularity but wraps quickly under load; the
+black box records the coarse, structured lifecycle events (admit /
+preempt / quarantine / finish / fault / SLO transition) that survive far
+longer in the same memory, and is dumped whole into every watchdog / SLO
+breach snapshot (``BatchEngine.resilience_snapshot``) or on demand.
+
+Flight-recorder semantics: always on, bounded, overwrite-oldest. Eviction
+is counted (``n_dropped``), never silent, and every event carries both
+the monotonic clock (ordering, latency math) and wall time (cross-process
+correlation with logs). Events are plain dicts so a dump is JSON-able
+as-is.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+import time
+
+
+class Blackbox:
+    """Bounded ring of ``{"t", "wall", "kind", ...fields}`` event dicts."""
+
+    def __init__(self, capacity: int = 1024, *, clock=time.monotonic):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._ring: collections.deque[dict] = collections.deque(
+            maxlen=self.capacity)
+        self.n_recorded = 0
+        self.n_dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._ring)
+
+    def record(self, kind: str, **fields) -> None:
+        """Append one event; evicts (and counts) the oldest when full."""
+        if len(self._ring) == self.capacity:
+            self.n_dropped += 1
+        ev = {"t": round(self.clock(), 6), "wall": round(time.time(), 6),
+              "kind": kind}
+        ev.update(fields)
+        self.n_recorded += 1
+        self._ring.append(ev)
+
+    def events(self, *, kind: str | None = None,
+               last: int | None = None) -> list[dict]:
+        """Ring contents oldest-first, optionally filtered to one ``kind``
+        and/or truncated to the last ``n``."""
+        evs = [e for e in self._ring if kind is None or e["kind"] == kind]
+        return evs[-last:] if last is not None else evs
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.n_recorded = 0
+        self.n_dropped = 0
+
+    def dump(self, *, last: int | None = None) -> dict:
+        """JSON-able bundle: counters + the event ring — what the breach
+        snapshot embeds."""
+        return {
+            "capacity": self.capacity,
+            "recorded": self.n_recorded,
+            "dropped": self.n_dropped,
+            "events": self.events(last=last),
+        }
+
+    def dump_json(self, path: str, *, last: int | None = None) -> str:
+        """Write ``dump()`` to ``path`` (dirs created); returns the path."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(self.dump(last=last), f, default=str)
+        return path

@@ -11,14 +11,24 @@ Additions over the serving-local version:
   labels     every record method takes ``labels={...}``; label sets are
              separate series of the same metric (Prometheus semantics).
   exposition ``to_prometheus()`` emits text exposition format (counters as
-             ``<name>_total``, histograms as summaries with quantile
-             series) for scrape endpoints or file snapshots.
+             ``<name>_total``, histograms with cumulative
+             ``_bucket{le=...}`` series from the fixed log-spaced bounds,
+             plus quantile/``_sum``/``_count`` series) for scrape
+             endpoints or file snapshots.
   deltas     ``snapshot()`` captures a point-in-time cursor; ``delta(s)``
              returns only what changed since — counter increments and
              histogram stats over the NEW observations only (per-step and
              per-window telemetry without resetting the registry).
   safety     ``as_dict()`` raises on key collisions instead of silently
              overwriting (see docstring there).
+  bounded    ``Histogram`` keeps a bounded reservoir of the most recent
+             observations (exact count/sum/min/max run alongside), so a
+             week-long serving run cannot OOM the host and every
+             percentile read sorts a bounded list.
+  windowed   ``Metrics(windowed=True)`` additionally feeds every
+             histogram observation and counter increment into an
+             ``obs.window.WindowRing``; ``window(name, window_s)``
+             answers "p99 over the last 10 s / 5 min" at constant memory.
 
 Schema (``as_dict()`` keys — the flat contract bench.py and
 scripts/serve_smoke.py consume):
@@ -31,40 +41,107 @@ scripts/serve_smoke.py consume):
 
 from __future__ import annotations
 
-import dataclasses
+import collections
+import itertools
 import math
 import re
+import time
+
+from triton_distributed_tpu.obs.window import (
+    DEFAULT_BOUNDS,
+    WindowRing,
+    bucket_index,
+)
+
+# Most-recent-observations reservoir cap: percentiles are exact for any
+# series under this many observations (the tier-1 workloads) and reflect
+# the trailing 8192 observations beyond it.
+DEFAULT_MAX_SAMPLES = 8192
 
 
-@dataclasses.dataclass
 class Histogram:
-    """Exact-sample histogram (serving loads here are 1e2-1e5 observations;
-    a streaming sketch would be premature)."""
+    """Bounded histogram: exact running count/sum/min/max, fixed
+    log-spaced value buckets for Prometheus exposition, and a reservoir
+    of the most recent ``max_samples`` observations for exact
+    small-sample percentiles.
 
-    samples: list = dataclasses.field(default_factory=list)
+    ``sum``/``mean`` read running accumulators — O(1) per read, not a
+    full-list scan per Prometheus scrape — and ``samples`` is a bounded
+    deque, so retained memory is constant in observation count.
+    """
+
+    __slots__ = ("samples", "bounds", "bucket_counts", "_count", "_sum",
+                 "_min", "_max")
+
+    def __init__(self, samples=None, *, max_samples: int = DEFAULT_MAX_SAMPLES,
+                 bounds=DEFAULT_BOUNDS):
+        self.samples: collections.deque = collections.deque(
+            maxlen=max_samples)
+        self.bounds = tuple(bounds)
+        self.bucket_counts = [0] * (len(self.bounds) + 1)
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        for v in samples or ():
+            self.observe(v)
 
     def observe(self, value: float) -> None:
-        self.samples.append(float(value))
+        value = float(value)
+        self.samples.append(value)
+        self._count += 1
+        self._sum += value
+        if value < self._min:
+            self._min = value
+        if value > self._max:
+            self._max = value
+        self.bucket_counts[bucket_index(value, self.bounds)] += 1
 
     @property
     def count(self) -> int:
-        return len(self.samples)
+        return self._count
 
     @property
     def sum(self) -> float:
-        return float(sum(self.samples))
+        return self._sum
 
     @property
     def mean(self) -> float:
-        return (sum(self.samples) / len(self.samples)) if self.samples else 0.0
+        return self._sum / self._count if self._count else 0.0
+
+    @property
+    def min(self) -> float:
+        return self._min if self._count else 0.0
+
+    @property
+    def max(self) -> float:
+        return self._max if self._count else 0.0
 
     def percentile(self, p: float) -> float:
-        """Nearest-rank percentile, p in [0, 100]."""
+        """Nearest-rank percentile, p in [0, 100] — exact over the
+        retained reservoir (every observation while under
+        ``max_samples``; the trailing window beyond it)."""
         if not self.samples:
             return 0.0
         s = sorted(self.samples)
         rank = max(0, min(len(s) - 1, math.ceil(p / 100.0 * len(s)) - 1))
         return s[rank]
+
+    def tail(self, n: int) -> list[float]:
+        """The most recent ``n`` observations still retained (all of them
+        when ``n`` exceeds the reservoir)."""
+        keep = min(int(n), len(self.samples))
+        return list(itertools.islice(self.samples,
+                                     len(self.samples) - keep, None))
+
+    def cumulative_buckets(self):
+        """Yield ``(upper_bound, cumulative_count)`` pairs over the fixed
+        bounds — the Prometheus ``_bucket{le=...}`` series (the +Inf
+        bucket is the total count, emitted by the caller)."""
+        cum = 0
+        for le, c in zip(self.bounds, self.bucket_counts):
+            cum += c
+            yield le, cum
 
 
 def _series_key(name: str, labels: dict | None) -> str:
@@ -105,18 +182,58 @@ def _prom_labels(labels: dict, extra: dict | None = None) -> str:
     return "{" + inner + "}"
 
 
-class Metrics:
-    """Named counters / gauges / histograms, created on first touch."""
+def _fmt_le(bound: float) -> str:
+    return f"{bound:g}"
 
-    def __init__(self):
+
+class Metrics:
+    """Named counters / gauges / histograms, created on first touch.
+
+    ``windowed=True`` additionally records every histogram observation and
+    counter increment into a per-series ``WindowRing`` (``bucket_s`` ×
+    ``n_buckets`` trailing coverage, 0.25 s × 1320 ≈ 5.5 min by default)
+    so ``window()``/``window_stats()``/``window_counter()`` can answer
+    trailing-window queries. Off (the default) the record methods are
+    byte-identical to the unwindowed registry.
+    """
+
+    def __init__(self, *, windowed: bool = False, window_bucket_s: float
+                 = 0.25, window_buckets: int = 1320, clock=time.monotonic,
+                 max_samples: int = DEFAULT_MAX_SAMPLES):
         self.counters: dict[str, float] = {}
         self.gauges: dict[str, float] = {}
         self.histograms: dict[str, Histogram] = {}
+        self.windowed = bool(windowed)
+        self.clock = clock
+        self._max_samples = max_samples
+        self._window_bucket_s = window_bucket_s
+        self._window_buckets = window_buckets
+        self._hist_windows: dict[str, WindowRing] = {}
+        self._counter_windows: dict[str, WindowRing] = {}
+
+    def _hist_ring(self, key: str) -> WindowRing:
+        ring = self._hist_windows.get(key)
+        if ring is None:
+            ring = self._hist_windows[key] = WindowRing(
+                bucket_s=self._window_bucket_s,
+                n_buckets=self._window_buckets, clock=self.clock)
+        return ring
+
+    def _counter_ring(self, key: str) -> WindowRing:
+        ring = self._counter_windows.get(key)
+        if ring is None:
+            ring = self._counter_windows[key] = WindowRing(
+                bucket_s=self._window_bucket_s,
+                n_buckets=self._window_buckets, bounds=None,
+                clock=self.clock)
+        return ring
 
     def inc(self, name: str, amount: float = 1.0, *,
             labels: dict | None = None) -> None:
         key = _series_key(name, labels)
         self.counters[key] = self.counters.get(key, 0.0) + amount
+        if self.windowed:
+            self._counter_ring(key).observe(amount)
 
     def set_gauge(self, name: str, value: float, *,
                   labels: dict | None = None) -> None:
@@ -124,8 +241,49 @@ class Metrics:
 
     def observe(self, name: str, value: float, *,
                 labels: dict | None = None) -> None:
-        self.histograms.setdefault(_series_key(name, labels),
-                                   Histogram()).observe(value)
+        key = _series_key(name, labels)
+        h = self.histograms.get(key)
+        if h is None:
+            h = self.histograms[key] = Histogram(
+                max_samples=self._max_samples)
+        h.observe(value)
+        if self.windowed:
+            self._hist_ring(key).observe(value)
+
+    # -- windowed queries ----------------------------------------------------
+
+    def window_stats(self, name: str, window_s: float, *,
+                     labels: dict | None = None):
+        """``WindowStats`` over the trailing window of a histogram series
+        (None when not windowed / series unseen) — the SLO engine's read
+        path."""
+        ring = self._hist_windows.get(_series_key(name, labels))
+        return ring.query(window_s) if ring is not None else None
+
+    def window_counter(self, name: str, window_s: float, *,
+                       labels: dict | None = None) -> float:
+        """Sum of a counter's increments over the trailing window (0.0
+        when not windowed / series unseen)."""
+        ring = self._counter_windows.get(_series_key(name, labels))
+        return ring.query(window_s).sum if ring is not None else 0.0
+
+    def window(self, name: str, window_s: float, *,
+               labels: dict | None = None) -> dict[str, float]:
+        """Flat trailing-window stats for dashboards: histogram series get
+        ``{count,mean,min,max,p50,p90,p99}``, counter series
+        ``{count,sum,rate_per_s}``, unknown series ``{}``."""
+        key = _series_key(name, labels)
+        ring = self._hist_windows.get(key)
+        if ring is not None:
+            return ring.query(window_s).as_dict()
+        ring = self._counter_windows.get(key)
+        if ring is not None:
+            st = ring.query(window_s)
+            out = st.as_dict()
+            out["rate_per_s"] = round(st.sum / window_s, 6) if window_s \
+                else 0.0
+            return out
+        return {}
 
     # -- flat export --------------------------------------------------------
 
@@ -157,8 +315,7 @@ class Metrics:
             put(f"{name}_p50", h.percentile(50), f"histogram {name!r}")
             put(f"{name}_p95", h.percentile(95), f"histogram {name!r}")
             put(f"{name}_p99", h.percentile(99), f"histogram {name!r}")
-            put(f"{name}_max", max(h.samples) if h.samples else 0.0,
-                f"histogram {name!r}")
+            put(f"{name}_max", h.max, f"histogram {name!r}")
         return out
 
     # -- delta snapshots ----------------------------------------------------
@@ -176,7 +333,8 @@ class Metrics:
         """Flat dict of CHANGES since ``since`` (a ``snapshot()`` result;
         None = since registry creation): counter increments, current gauge
         values, and histogram stats computed over only the observations
-        made after the snapshot."""
+        made after the snapshot (exact while the new observations fit the
+        reservoir; the trailing-window approximation beyond it)."""
         since = since or {"counters": {}, "gauges": {}, "hist_counts": {}}
         out: dict[str, float] = {}
         for k, v in self.counters.items():
@@ -187,24 +345,30 @@ class Metrics:
             if v != since["gauges"].get(k):
                 out[k] = v
         for name, h in self.histograms.items():
-            new = Histogram(h.samples[since["hist_counts"].get(name, 0):])
-            if not new.count:
+            n_new = h.count - since["hist_counts"].get(name, 0)
+            if n_new <= 0:
                 continue
-            out[f"{name}_count"] = float(new.count)
+            new = Histogram(h.tail(n_new))
+            out[f"{name}_count"] = float(n_new)
             out[f"{name}_mean"] = new.mean
             out[f"{name}_p50"] = new.percentile(50)
             out[f"{name}_p95"] = new.percentile(95)
             out[f"{name}_p99"] = new.percentile(99)
-            out[f"{name}_max"] = max(new.samples)
+            out[f"{name}_max"] = new.max
         return out
 
     # -- Prometheus text exposition -----------------------------------------
 
     def to_prometheus(self) -> str:
         """Text exposition (format 0.0.4): counters as ``<name>_total``,
-        gauges verbatim, histograms as summaries (p50/p95/p99 quantile series
-        plus ``_sum``/``_count``). Invalid name characters sanitize to
-        ``_``; labels carry through."""
+        gauges verbatim, histograms as real Prometheus histograms —
+        cumulative ``_bucket{le="..."}`` series over the fixed log-spaced
+        bounds (``+Inf`` = total count) plus ``_sum``/``_count``, with the
+        p50/p95/p99 quantile series kept as companion gauges for human
+        readers. Cost is bounded per series (running sums + fixed bucket
+        arrays), independent of how many observations were ever made.
+        Invalid name characters sanitize to ``_``; labels carry
+        through."""
         lines: list[str] = []
         seen_types: set[str] = set()
 
@@ -226,7 +390,14 @@ class Metrics:
         for key, h in sorted(self.histograms.items()):
             name, labels = _split_series(key)
             pname = _prom_name(name)
-            header(pname, "summary")
+            header(pname, "histogram")
+            for le, cum in h.cumulative_buckets():
+                lines.append(
+                    f"{pname}_bucket{_prom_labels(labels, {'le': _fmt_le(le)})}"
+                    f" {cum}")
+            lines.append(
+                f"{pname}_bucket{_prom_labels(labels, {'le': '+Inf'})} "
+                f"{h.count}")
             for q, p in (("0.5", 50), ("0.95", 95), ("0.99", 99)):
                 lines.append(
                     f"{pname}{_prom_labels(labels, {'quantile': q})} "
@@ -239,7 +410,9 @@ class Metrics:
 def parse_prometheus(text: str) -> dict[str, float]:
     """Parse text exposition back to ``{series: value}`` (comment lines
     dropped, label order normalized) — the round-trip check for tests and
-    for scraping a snapshot file without a client library."""
+    for scraping a snapshot file without a client library. Histogram
+    ``_bucket{le=...}`` series round-trip as ``name_bucket{le=<bound>}``
+    keys."""
     out: dict[str, float] = {}
     for line in text.splitlines():
         line = line.strip()

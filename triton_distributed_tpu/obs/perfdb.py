@@ -231,13 +231,30 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 # "overhead_frac" (bench --probe-overhead: telemetry cost vs plain build)
 # and "warm_over_cold" (bench --serve: warm/cold TTFT ratio — a warm
 # prefix cache should shrink it, despite the "ratio"/"_cold" spelling).
+# "slo_breach" (bench --serve --slo: breach count under a healthy load)
+# carries no latency spelling at all but more breaches are strictly worse.
 _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
-                           "warm_over_cold")
+                           "warm_over_cold", "slo_breach")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
                         "hit_rate")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
+
+# Overhead fractions measure a cost RATIO bounded near zero, so the
+# contract is the absolute budget (the bench arms enforce <= 5% where
+# they gate), not the relative delta between two near-zero numbers:
+# back-to-back wall-clock jitter turns 2% vs 4% into "+90%" while both
+# sit deep inside budget. Metrics matching these hints change status
+# only when the absolute delta also exceeds the slack.
+_ABS_SLACK_METRICS = ("overhead_frac",)
+_ABS_SLACK = 0.05
+
+
+def _within_abs_slack(name: str, base_v: float, head_v: float) -> bool:
+    low = name.lower()
+    return (any(hint in low for hint in _ABS_SLACK_METRICS)
+            and abs(head_v - base_v) <= _ABS_SLACK)
 
 
 def metric_direction(name: str) -> int:
@@ -298,7 +315,10 @@ def compare(base_runs: list[RunRecord], head_runs: list[RunRecord], *,
     best-observed quartile; ``delta_frac`` is signed so that POSITIVE
     always means "head is worse" regardless of metric direction, and a
     verdict regresses only beyond ``tolerance``. Unknown-direction metrics
-    never regress (status "unchanged" with the delta reported).
+    never regress (status "unchanged" with the delta reported), and
+    overhead-fraction metrics additionally need the ABSOLUTE delta to
+    exceed ``_ABS_SLACK`` (two near-zero cost ratios inside the budget
+    are equal for gating purposes, whatever their ratio).
 
     Refuses (``FingerprintMismatch``) when any pair of involved runs is
     not environment-comparable — unless ``check_fingerprints=False``."""
@@ -350,7 +370,7 @@ def compare(base_runs: list[RunRecord], head_runs: list[RunRecord], *,
             raw = (head_v - base_v) / abs(base_v)
             # Signed so + is always "worse": flip for higher-is-better.
             delta = raw if direction <= 0 else -raw
-        if direction == 0:
+        if direction == 0 or _within_abs_slack(name, base_v, head_v):
             status = "unchanged"
         elif delta > tolerance:
             status = "regressed"

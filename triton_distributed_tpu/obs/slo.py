@@ -1,0 +1,246 @@
+"""Declarative SLO objectives with multi-window burn-rate evaluation.
+
+The Google-SRE multiwindow alerting shape, scaled to a serving loop: an
+``Objective`` declares a target (ttft_p99 <= 500 ms, error rate <= 5%,
+prefix hit rate >= 40%) and the ``SLOEngine`` evaluates it over TWO
+trailing windows of the windowed ``obs.metrics`` registry —
+
+  fast window   (default 10 s)  trips quickly; catches an incident as it
+                starts but would page on blips alone.
+  slow window   (default 60 s)  trips only under sustained damage; slow
+                to clear, so it alone would page long after recovery.
+
+For latency objectives the per-window signal is the BURN RATE: the
+fraction of windowed observations violating the threshold, divided by
+the objective's error budget (p99 target => 1% budget). A window trips
+when the burn rate reaches ``burn`` (default 6 — budget consumed 6x
+faster than allowed). Because the fast window saturates with bad samples
+long before they amount to ``burn``x the slow window's budget, a
+sustained fault deterministically walks the state machine
+
+  OK -> WARN (fast window tripped) -> BREACH (both windows tripped)
+
+and recovery walks it back down. Ratio/rate objectives (error rate, hit
+floor) compare the windowed value against the threshold directly.
+
+Every transition is recorded (``transitions``, plus an ``on_transition``
+callback); a transition INTO ``BREACH`` is the hook the serving engine
+wires to the resilience ``Watchdog.snapshot`` path, so an SLO violation
+produces the same forensic bundle a watchdog breach does (blackbox ring,
+windowed percentiles, sampled offending traces — see
+``serving/batch_engine.py``).
+
+Deterministic by construction: evaluation reads only the injectable
+clocks already inside the windowed registry, so tests drive OK→WARN→
+BREACH with a fake clock or with the seeded resilience ``FaultPlan``
+latency fault.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+OK = "OK"
+WARN = "WARN"
+BREACH = "BREACH"
+
+# Gauge encoding of a state (``slo_state{objective=...}``).
+STATE_LEVEL = {OK: 0, WARN: 1, BREACH: 2}
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """One declarative target.
+
+    kinds:
+      latency   ``metric`` is a histogram series; the windowed violation
+                fraction (observations above ``threshold``) against
+                ``budget`` defines the burn rate.
+      ratio     ``metric`` (numerator counter) over the sum of
+                ``denominator`` counters, both over the window; the value
+                compares against ``threshold`` per ``direction``.
+      rate      ``metric`` counter increments per second over the window,
+                compared against ``threshold`` per ``direction``.
+
+    ``direction`` "le": healthy while value <= threshold (ceilings);
+    "ge": healthy while value >= threshold (floors, e.g. hit rate).
+    ``min_count`` observations (latency) / denominator mass (ratio)
+    required before a window may trip — cold windows read as healthy.
+    """
+
+    name: str
+    kind: str
+    metric: str
+    threshold: float
+    denominator: tuple = ()
+    direction: str = "le"
+    budget: float = 0.01
+    burn: float = 6.0
+    fast_window_s: float = 10.0
+    slow_window_s: float = 60.0
+    min_count: int = 8
+
+    def __post_init__(self):
+        if self.kind not in ("latency", "ratio", "rate"):
+            raise ValueError(f"unknown objective kind {self.kind!r}")
+        if self.direction not in ("le", "ge"):
+            raise ValueError(f"direction {self.direction!r}: 'le' or 'ge'")
+        if self.kind == "latency" and not 0.0 < self.budget <= 1.0:
+            raise ValueError(f"latency budget {self.budget} not in (0, 1]")
+        if self.kind == "ratio" and not self.denominator:
+            raise ValueError("ratio objective needs denominator counters")
+        if self.fast_window_s >= self.slow_window_s:
+            raise ValueError("fast window must be shorter than slow window")
+
+    # -- constructors --------------------------------------------------------
+
+    @staticmethod
+    def latency(name: str, metric: str, threshold_s: float, *,
+                quantile: float = 0.99, **kw) -> "Objective":
+        """``<metric> p<quantile> <= threshold_s`` (budget = 1-quantile)."""
+        return Objective(name=name, kind="latency", metric=metric,
+                         threshold=threshold_s,
+                         budget=round(1.0 - quantile, 6), **kw)
+
+    @staticmethod
+    def ratio_ceiling(name: str, num: str, den, ceiling: float,
+                      **kw) -> "Objective":
+        den = (den,) if isinstance(den, str) else tuple(den)
+        return Objective(name=name, kind="ratio", metric=num,
+                         denominator=den, threshold=ceiling,
+                         direction="le", **kw)
+
+    @staticmethod
+    def ratio_floor(name: str, num: str, den, floor: float,
+                    **kw) -> "Objective":
+        den = (den,) if isinstance(den, str) else tuple(den)
+        return Objective(name=name, kind="ratio", metric=num,
+                         denominator=den, threshold=floor,
+                         direction="ge", **kw)
+
+
+def default_serving_slo(*, ttft_p99_s: float = 1.0, tbt_p99_s: float = 0.25,
+                        error_rate: float = 0.05,
+                        prefix_hit_floor: float | None = None,
+                        fast_window_s: float = 10.0,
+                        slow_window_s: float = 60.0,
+                        min_count: int = 8) -> list[Objective]:
+    """The stock serving objective set: TTFT/TBT tails, the quarantine
+    (error) rate ceiling, and optionally a prefix-cache hit-rate floor."""
+    w = dict(fast_window_s=fast_window_s, slow_window_s=slow_window_s,
+             min_count=min_count)
+    objs = [
+        Objective.latency("ttft_p99", "ttft_s", ttft_p99_s, **w),
+        Objective.latency("tbt_p99", "tbt_s", tbt_p99_s, **w),
+        Objective.ratio_ceiling(
+            "error_rate", "requests_failed",
+            ("requests_completed", "requests_failed"), error_rate, **w),
+    ]
+    if prefix_hit_floor is not None:
+        objs.append(Objective.ratio_floor(
+            "prefix_hit_rate", "prefix_hits", "prefix_lookups",
+            prefix_hit_floor, **w))
+    return objs
+
+
+class SLOEngine:
+    """Evaluates ``objectives`` against a WINDOWED ``obs.metrics.Metrics``
+    and runs the OK/WARN/BREACH state machine per objective."""
+
+    def __init__(self, objectives, metrics, *, on_transition=None,
+                 clock=time.monotonic, max_transitions: int = 256):
+        if not getattr(metrics, "windowed", False):
+            raise ValueError("SLOEngine needs Metrics(windowed=True) — "
+                             "trailing-window queries are its read path")
+        self.objectives = list(objectives)
+        names = [o.name for o in self.objectives]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate objective names in {names}")
+        self.metrics = metrics
+        self.on_transition = on_transition
+        self.clock = clock
+        self.states: dict[str, str] = {o.name: OK for o in self.objectives}
+        self.transitions: list[dict] = []
+        self._max_transitions = max_transitions
+        self.n_breaches = 0
+        self.n_evaluations = 0
+
+    # -- per-window probe ----------------------------------------------------
+
+    def _probe(self, o: Objective, window_s: float) -> dict:
+        """One window's verdict: ``{"trip": bool, "value", "count"}``.
+        ``value`` is the burn rate (latency) or the windowed value
+        (ratio/rate); None while the window lacks ``min_count`` data."""
+        if o.kind == "latency":
+            st = self.metrics.window_stats(o.metric, window_s)
+            if st is None or st.count < o.min_count:
+                return {"trip": False, "value": None,
+                        "count": st.count if st else 0}
+            burn_rate = st.frac_gt(o.threshold) / o.budget
+            return {"trip": burn_rate >= o.burn,
+                    "value": round(burn_rate, 4), "count": st.count}
+        if o.kind == "ratio":
+            num = self.metrics.window_counter(o.metric, window_s)
+            den = sum(self.metrics.window_counter(d, window_s)
+                      for d in o.denominator)
+            if den < o.min_count:
+                return {"trip": False, "value": None, "count": int(den)}
+            value, count = num / den, int(den)
+        else:  # rate
+            mass = self.metrics.window_counter(o.metric, window_s)
+            value, count = mass / window_s, int(mass)
+        bad = (value > o.threshold if o.direction == "le"
+               else value < o.threshold)
+        return {"trip": bad, "value": round(value, 6), "count": count}
+
+    # -- state machine -------------------------------------------------------
+
+    def evaluate(self, now: float | None = None) -> dict[str, str]:
+        """One evaluation pass; returns the post-pass state per objective.
+        BREACH requires BOTH windows tripped; either one alone is WARN."""
+        now = self.clock() if now is None else now
+        self.n_evaluations += 1
+        for o in self.objectives:
+            fast = self._probe(o, o.fast_window_s)
+            slow = self._probe(o, o.slow_window_s)
+            if fast["trip"] and slow["trip"]:
+                new = BREACH
+            elif fast["trip"] or slow["trip"]:
+                new = WARN
+            else:
+                new = OK
+            old = self.states[o.name]
+            if new == old:
+                continue
+            self.states[o.name] = new
+            detail = {"fast": fast, "slow": slow,
+                      "threshold": o.threshold, "kind": o.kind}
+            rec = {"t": round(now, 6), "objective": o.name, "old": old,
+                   "new": new, "detail": detail}
+            self.transitions.append(rec)
+            del self.transitions[:-self._max_transitions]
+            if new == BREACH:
+                self.n_breaches += 1
+            if self.on_transition is not None:
+                self.on_transition(o, old, new, detail)
+        return dict(self.states)
+
+    # -- reporting -----------------------------------------------------------
+
+    def verdicts(self) -> dict[str, str]:
+        """Current state per objective (no evaluation side effects)."""
+        return dict(self.states)
+
+    def summary(self) -> dict:
+        """JSON-able bundle for snapshots / bench extras: states, counts,
+        and the recent transition log."""
+        worst = max(self.states.values(), key=STATE_LEVEL.__getitem__,
+                    default=OK)
+        return {
+            "states": dict(self.states),
+            "worst": worst,
+            "breaches": self.n_breaches,
+            "evaluations": self.n_evaluations,
+            "transitions": list(self.transitions[-32:]),
+        }

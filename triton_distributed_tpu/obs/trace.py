@@ -23,7 +23,18 @@ This tracer fills that gap:
 
 Disabled (the default) the tracer is a single attribute check returning a
 shared ``nullcontext`` — cheap enough to leave call sites in the serving
-hot loop permanently.
+hot loop permanently. Ring-buffer wraps are COUNTED (``Tracer.dropped``,
+module-level ``dropped_spans()``) and surfaced in the Chrome-export
+metadata and the serving ``trace_dropped_spans`` gauge — a truncated
+trace is never mistaken for a complete one.
+
+``TailSampler`` is the always-on production sampling layer on top: every
+request's lifecycle events buffer cheaply while in flight, and at finish
+the trace is KEPT only when the request was head-sampled (a seeded,
+deterministic fraction), ran slow (``mark_slow`` fires the moment any
+single token exceeds ``slow_s``, so an in-flight straggler is already
+kept when an SLO breach snapshot fires), or errored. Everything else is
+dropped and counted — tail visibility at bounded cost.
 
 ``group_profile`` (the XProf capture context re-exported through
 ``runtime/utils.py``) lives here too: it creates the trace directory up
@@ -39,6 +50,7 @@ import dataclasses
 import glob
 import json
 import os
+import random
 import threading
 import time
 from typing import Any
@@ -69,6 +81,17 @@ class Tracer:
         self._records: collections.deque[SpanRecord] = collections.deque(
             maxlen=capacity)
         self._local = threading.local()
+        # Ring-wrap evictions since the last reset(): the deque drops the
+        # oldest record silently, so the count lives here and surfaces as
+        # the ``trace_dropped_spans`` metric and in the Chrome-export
+        # summary — a truncated trace announces itself.
+        self.dropped = 0
+
+    def _append(self, rec: SpanRecord) -> None:
+        if (self._records.maxlen is not None
+                and len(self._records) == self._records.maxlen):
+            self.dropped += 1
+        self._records.append(rec)
 
     # -- state --------------------------------------------------------------
 
@@ -90,6 +113,7 @@ class Tracer:
     def reset(self) -> None:
         self._records.clear()
         self._local = threading.local()
+        self.dropped = 0
 
     def __len__(self) -> int:
         return len(self._records)
@@ -112,7 +136,7 @@ class Tracer:
         if not self.enabled:
             return
         now = time.perf_counter()
-        self._records.append(SpanRecord(
+        self._append(SpanRecord(
             name=name, t_start=now, t_end=now, wall_start=time.time(),
             depth=len(self._stack()), tid=threading.get_ident(),
             phase="i", attrs=attrs or None))
@@ -123,7 +147,7 @@ class Tracer:
         if not self.enabled:
             return
         now = time.perf_counter()
-        self._records.append(SpanRecord(
+        self._append(SpanRecord(
             name=name, t_start=now, t_end=now, wall_start=time.time(),
             depth=0, tid=threading.get_ident(), phase="b",
             async_id=async_id, attrs=attrs or None))
@@ -132,7 +156,7 @@ class Tracer:
         if not self.enabled:
             return
         now = time.perf_counter()
-        self._records.append(SpanRecord(
+        self._append(SpanRecord(
             name=name, t_start=now, t_end=now, wall_start=time.time(),
             depth=0, tid=threading.get_ident(), phase="e",
             async_id=async_id, attrs=attrs or None))
@@ -193,7 +217,9 @@ class Tracer:
         payload = {
             "traceEvents": self.chrome_events(),
             "displayTimeUnit": "ms",
-            "metadata": {"process_index": pid, "wall_time": time.time()},
+            "metadata": {"process_index": pid, "wall_time": time.time(),
+                         "recorded_spans": len(self._records),
+                         "dropped_spans": self.dropped},
         }
         with open(path, "w") as f:
             json.dump(payload, f)
@@ -242,7 +268,7 @@ class _SpanContext:
         stack = self._tracer._stack()
         if stack and stack[-1] == self._name:
             stack.pop()
-        self._tracer._records.append(SpanRecord(
+        self._tracer._append(SpanRecord(
             name=self._name, t_start=self._t0, t_end=t_end,
             wall_start=self._wall0, depth=self._depth,
             tid=threading.get_ident(), attrs=self._attrs or None))
@@ -296,6 +322,11 @@ def export_chrome_trace(dir: str) -> str:
     return _TRACER.export_chrome_trace(dir)
 
 
+def dropped_spans() -> int:
+    """Ring-wrap evictions on the process-global tracer since reset()."""
+    return _TRACER.dropped
+
+
 @contextlib.contextmanager
 def tracing(capacity: int | None = None):
     """Scoped enable/disable (restores the prior enabled state)."""
@@ -319,6 +350,141 @@ def merge_chrome_traces(dir: str, out_name: str = "trace.merged.json") -> str:
     with open(out, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)
     return out
+
+
+# ---------------------------------------------------------------------------
+# Per-request tail sampling
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    """One request's buffered lifecycle events + the keep decision."""
+
+    req_id: object
+    t_begin: float                      # time.monotonic at begin()
+    head_sampled: bool = False
+    kept_reason: str | None = None      # "head" | "slow" | "error" | None
+    attrs: dict = dataclasses.field(default_factory=dict)
+    events: list = dataclasses.field(default_factory=list)
+    n_event_drops: int = 0              # per-request buffer overflow
+
+    def event(self, name: str, t: float, max_events: int, **attrs) -> None:
+        if len(self.events) >= max_events:
+            self.n_event_drops += 1
+            return
+        self.events.append({"t": round(t - self.t_begin, 6), "name": name,
+                            **{k: _jsonable(v) for k, v in attrs.items()}})
+
+    def as_dict(self) -> dict:
+        return {"req_id": str(self.req_id),
+                "head_sampled": self.head_sampled,
+                "kept_reason": self.kept_reason,
+                "attrs": {k: _jsonable(v) for k, v in self.attrs.items()},
+                "events": list(self.events),
+                "event_drops": self.n_event_drops}
+
+
+class TailSampler:
+    """Always-on per-request trace sampling: keep ALL slow/errored
+    requests (the tail — the ones worth debugging) plus a deterministic
+    ``head_frac`` of everything else, at bounded memory.
+
+    ``head_frac``   fraction of requests kept unconditionally, decided at
+                    ``begin()`` from a seeded RNG — deterministic over
+                    submit order, so reruns sample the same requests.
+    ``slow_s``      a request becomes tail-kept the moment any single
+                    latency the engine reports (TTFT, one TBT gap, or the
+                    final e2e) exceeds this. ``mark_slow`` makes the keep
+                    IMMEDIATE, so a breach snapshot taken while the
+                    straggler is still in flight already contains it.
+    ``keep``        bounded ring of kept traces (oldest evicted+counted).
+    ``max_events``/``max_pending`` per-request and in-flight caps — every
+                    bound is explicit and every overflow is counted.
+    """
+
+    def __init__(self, *, head_frac: float = 0.05, slow_s: float | None
+                 = 1.0, keep: int = 256, max_events: int = 64,
+                 max_pending: int = 4096, seed: int = 0):
+        if not 0.0 <= head_frac <= 1.0:
+            raise ValueError(f"head_frac {head_frac} not in [0, 1]")
+        self.head_frac = head_frac
+        self.slow_s = slow_s
+        self.max_events = max_events
+        self.max_pending = max_pending
+        self._rng = random.Random(seed)
+        self._pending: dict[object, RequestTrace] = {}
+        self.kept: collections.deque[RequestTrace] = collections.deque(
+            maxlen=keep)
+        self.n_begun = 0
+        self.n_kept_head = 0
+        self.n_kept_tail = 0
+        self.n_dropped = 0          # finished un-kept (the sampled-out bulk)
+        self.n_overflow = 0         # begins refused by the pending cap
+
+    def begin(self, req_id, **attrs) -> None:
+        if len(self._pending) >= self.max_pending:
+            self.n_overflow += 1
+            return
+        self.n_begun += 1
+        rt = RequestTrace(req_id=req_id, t_begin=time.monotonic(),
+                          head_sampled=self._rng.random() < self.head_frac,
+                          attrs=dict(attrs))
+        self._pending[req_id] = rt
+
+    def event(self, req_id, name: str, **attrs) -> None:
+        rt = self._pending.get(req_id)
+        if rt is not None:
+            rt.event(name, time.monotonic(), self.max_events, **attrs)
+
+    def _keep(self, rt: RequestTrace, reason: str) -> None:
+        if rt.kept_reason is None:
+            rt.kept_reason = reason
+            if reason == "head":
+                self.n_kept_head += 1
+            else:
+                self.n_kept_tail += 1
+            self.kept.append(rt)
+
+    def mark_slow(self, req_id, **attrs) -> None:
+        """Tail-keep an IN-FLIGHT request (e.g. one token gap already blew
+        ``slow_s``) so breach-time snapshots see the offender now."""
+        rt = self._pending.get(req_id)
+        if rt is not None:
+            rt.attrs.update(attrs)
+            self._keep(rt, "slow")
+
+    def finish(self, req_id, *, latency_s: float | None = None,
+               error: str | None = None, **attrs) -> bool:
+        """Close a request and decide; returns True when the trace was
+        kept (head sample, slow, or errored)."""
+        rt = self._pending.pop(req_id, None)
+        if rt is None:
+            return False
+        rt.attrs.update(attrs)
+        if latency_s is not None:
+            rt.attrs["latency_s"] = round(latency_s, 6)
+        if error is not None:
+            rt.attrs["error"] = error
+            self._keep(rt, "error")
+        elif (self.slow_s is not None and latency_s is not None
+                and latency_s > self.slow_s):
+            self._keep(rt, "slow")
+        elif rt.head_sampled:
+            self._keep(rt, "head")
+        if rt.kept_reason is None:
+            self.n_dropped += 1
+        return rt.kept_reason is not None
+
+    @property
+    def n_pending(self) -> int:
+        return len(self._pending)
+
+    def stats(self) -> dict:
+        return {"begun": self.n_begun, "pending": self.n_pending,
+                "kept_head": self.n_kept_head,
+                "kept_tail": self.n_kept_tail, "dropped": self.n_dropped,
+                "overflow": self.n_overflow, "retained": len(self.kept)}
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,232 @@
+"""Bounded sliding-window aggregation: fixed-bucket streaming quantiles
+over a time-bucketed ring.
+
+The serving metrics problem this solves: ``Histogram`` answers "p99 since
+process start", but an SLO engine and a live dashboard need "p99 over the
+LAST 10 seconds / 5 minutes" — and they need it from a structure whose
+memory is constant in request count, because the serving loop runs for
+weeks. Two pieces:
+
+  fixed buckets   observations land in log-spaced value buckets
+                  (``DEFAULT_BOUNDS``, 8 per decade across 1e-4..1e2 —
+                  sub-ms to minutes). Quantiles interpolate inside the
+                  containing bucket, so worst-case quantile error is the
+                  bucket ratio (~33%), far inside SLO-threshold margins.
+                  The same bounds feed ``Metrics.to_prometheus``'s
+                  cumulative ``_bucket{le=...}`` exposition.
+  window ring     ``WindowRing`` holds ``n_buckets`` TIME buckets of
+                  ``bucket_s`` seconds each, addressed by
+                  ``period % n_buckets``; a bucket whose stored period is
+                  stale is reset on touch, so expiry is O(1) and lazy —
+                  no timer thread. ``query(window_s)`` merges the buckets
+                  covering the trailing window into a ``WindowStats``.
+
+Everything takes an injectable ``clock`` (default ``time.monotonic``) so
+the SLO state-machine tests drive windows deterministically with a fake
+clock. No numpy, no jax: this sits under ``obs.metrics`` which must import
+anywhere.
+"""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import time
+
+# Log-spaced value-bucket upper bounds: 8 per decade, 1e-4 .. 1e2 seconds
+# (0.1 ms .. ~1.7 min). Serving latencies (TTFT/TBT/queue-wait) and most
+# dimensionless serving ratios live comfortably inside; out-of-range
+# values land in the first / overflow bucket and still count exactly in
+# count/sum/min/max.
+DEFAULT_BOUNDS: tuple[float, ...] = tuple(
+    round(10.0 ** (-4 + i / 8.0), 10) for i in range(49))
+
+
+def bucket_index(value: float, bounds=DEFAULT_BOUNDS) -> int:
+    """Index of the value bucket ``value`` falls in: bucket ``i`` covers
+    ``(bounds[i-1], bounds[i]]``; index ``len(bounds)`` is the +Inf
+    overflow bucket."""
+    return bisect.bisect_left(bounds, value)
+
+
+@dataclasses.dataclass
+class WindowStats:
+    """Merged statistics over one trailing window: exact count/sum/min/max
+    plus per-value-bucket counts for quantile / threshold queries."""
+
+    count: int = 0
+    sum: float = 0.0
+    min: float = 0.0
+    max: float = 0.0
+    bounds: tuple = DEFAULT_BOUNDS
+    counts: list | None = None      # len(bounds)+1; None for counter rings
+
+    @property
+    def mean(self) -> float:
+        return self.sum / self.count if self.count else 0.0
+
+    def _edges(self, i: int) -> tuple[float, float]:
+        """(lo, hi) value edges of bucket ``i``, clamped to observed
+        min/max so interpolation never extrapolates past real data."""
+        lo = self.bounds[i - 1] if i > 0 else self.min
+        hi = self.bounds[i] if i < len(self.bounds) else self.max
+        lo = max(lo, self.min)
+        hi = min(hi, self.max)
+        return (lo, hi) if hi >= lo else (lo, lo)
+
+    def quantile(self, p: float) -> float:
+        """Interpolated quantile, ``p`` in [0, 100]. Exact at the bucket
+        edges; linear inside the containing bucket."""
+        if not self.count or self.counts is None:
+            return 0.0
+        target = max(1.0, p / 100.0 * self.count)
+        cum = 0
+        for i, c in enumerate(self.counts):
+            if not c:
+                continue
+            if cum + c >= target:
+                lo, hi = self._edges(i)
+                frac = (target - cum) / c
+                return min(self.max, max(self.min, lo + frac * (hi - lo)))
+            cum += c
+        return self.max
+
+    def frac_gt(self, threshold: float) -> float:
+        """Fraction of windowed observations strictly above ``threshold``
+        (the SLO violation fraction), interpolating inside the bucket the
+        threshold falls in."""
+        if not self.count or self.counts is None:
+            return 0.0
+        if threshold < self.min:
+            return 1.0
+        if threshold >= self.max:
+            return 0.0
+        j = bucket_index(threshold, self.bounds)
+        above = float(sum(self.counts[j + 1:]))
+        c = self.counts[j]
+        if c:
+            lo, hi = self._edges(j)
+            inside = (hi - threshold) / (hi - lo) if hi > lo else 0.0
+            above += c * min(1.0, max(0.0, inside))
+        return min(1.0, max(0.0, above / self.count))
+
+    def as_dict(self) -> dict[str, float]:
+        """Flat stats for dashboards / snapshots."""
+        out = {"count": float(self.count), "mean": round(self.mean, 6),
+               "min": round(self.min, 6), "max": round(self.max, 6)}
+        if self.counts is not None:
+            for p in (50, 90, 99):
+                out[f"p{p}"] = round(self.quantile(p), 6)
+        else:
+            out["sum"] = round(self.sum, 6)
+        return out
+
+
+class _TimeBucket:
+    """One ring slot: the stats of one ``bucket_s`` period. ``counts`` is
+    allocated lazily so an idle ring holds no per-bucket arrays."""
+
+    __slots__ = ("period", "count", "sum", "min", "max", "counts")
+
+    def __init__(self):
+        self.reset(-1)
+
+    def reset(self, period: int) -> None:
+        self.period = period
+        self.count = 0
+        self.sum = 0.0
+        self.min = 0.0
+        self.max = 0.0
+        self.counts = None
+
+
+class WindowRing:
+    """Time-bucketed ring of fixed-bucket histograms.
+
+    ``bucket_s``   time-bucket width; the resolution floor of any window
+                   query (a 10 s window over 0.25 s buckets merges 40).
+    ``n_buckets``  ring length; ``bucket_s * n_buckets`` is the longest
+                   queryable window. Memory is ``n_buckets`` bucket
+                   objects + one count array per RECENTLY TOUCHED bucket —
+                   constant in observation count.
+    ``bounds``     value-bucket upper edges (None = counter mode: the ring
+                   tracks count/sum only — windowed counter increments).
+    ``clock``      injectable time source (tests pass a fake).
+    """
+
+    def __init__(self, *, bucket_s: float = 1.0, n_buckets: int = 300,
+                 bounds=DEFAULT_BOUNDS, clock=time.monotonic):
+        if bucket_s <= 0 or n_buckets < 2:
+            raise ValueError(f"need bucket_s > 0 and n_buckets >= 2, got "
+                             f"{bucket_s}/{n_buckets}")
+        self.bucket_s = float(bucket_s)
+        self.n_buckets = int(n_buckets)
+        self.bounds = tuple(bounds) if bounds is not None else None
+        self.clock = clock
+        self._ring = [_TimeBucket() for _ in range(self.n_buckets)]
+
+    @property
+    def max_window_s(self) -> float:
+        return self.bucket_s * self.n_buckets
+
+    def _bucket(self, now: float) -> _TimeBucket:
+        period = int(now / self.bucket_s)
+        b = self._ring[period % self.n_buckets]
+        if b.period != period:
+            b.reset(period)
+        return b
+
+    def observe(self, value: float, now: float | None = None) -> None:
+        value = float(value)
+        b = self._bucket(self.clock() if now is None else now)
+        if not b.count or value < b.min:
+            b.min = value
+        if not b.count or value > b.max:
+            b.max = value
+        b.count += 1
+        b.sum += value
+        if self.bounds is not None:
+            if b.counts is None:
+                b.counts = [0] * (len(self.bounds) + 1)
+            b.counts[bucket_index(value, self.bounds)] += 1
+
+    def query(self, window_s: float, now: float | None = None
+              ) -> WindowStats:
+        """Merge the time buckets covering the trailing ``window_s``
+        seconds. Windows longer than the ring clamp to the ring."""
+        now = self.clock() if now is None else now
+        window_s = min(float(window_s), self.max_window_s)
+        period_now = int(now / self.bucket_s)
+        n_back = max(1, -(-window_s // self.bucket_s))
+        oldest = period_now - int(n_back) + 1
+        st = WindowStats(bounds=self.bounds or DEFAULT_BOUNDS,
+                         counts=None)
+        merged = None
+        for b in self._ring:
+            if not b.count or not oldest <= b.period <= period_now:
+                continue
+            if not st.count or b.min < st.min:
+                st.min = b.min
+            if not st.count or b.max > st.max:
+                st.max = b.max
+            st.count += b.count
+            st.sum += b.sum
+            if b.counts is not None:
+                if merged is None:
+                    merged = list(b.counts)
+                else:
+                    for i, c in enumerate(b.counts):
+                        if c:
+                            merged[i] += c
+        st.counts = merged if self.bounds is not None else None
+        if self.bounds is not None and merged is None and st.count:
+            # counter-style data under histogram bounds (shouldn't happen,
+            # but stay queryable)
+            st.counts = [0] * (len(self.bounds) + 1)
+        return st
+
+    def rate(self, window_s: float, now: float | None = None) -> float:
+        """Sum over the window divided by the window — increments/s for
+        counter rings, value-mass/s for histogram rings."""
+        window_s = min(float(window_s), self.max_window_s)
+        return self.query(window_s, now).sum / window_s if window_s else 0.0

@@ -37,6 +37,7 @@ attached — zero hooks fire by default.
 
 from __future__ import annotations
 
+import collections
 import contextlib
 import json
 import os
@@ -76,12 +77,18 @@ class Watchdog:
         self.on_breach = on_breach
         self.breaches: list[str] = []
         self.last_snapshot: dict | None = None
+        # Bounded history: an incident can snapshot several times (an SLO
+        # breach followed by a deadline breach) — keep the recent few, not
+        # just the latest, without unbounded growth.
+        self.snapshots: collections.deque[dict] = collections.deque(maxlen=8)
         self._lock = threading.Lock()
 
     # -- snapshots ----------------------------------------------------------
 
-    def snapshot(self, reason: str) -> dict:
-        """Collect + persist the diagnostic snapshot for ``reason``."""
+    def snapshot(self, reason: str, extra: dict | None = None) -> dict:
+        """Collect + persist the diagnostic snapshot for ``reason``.
+        ``extra`` (e.g. the SLO engine's breach detail) merges in after the
+        provider, so callers can annotate without a custom provider."""
         snap: dict = {"reason": reason, "wall_time": time.time()}
         if self.snapshot_provider is not None:
             try:
@@ -94,8 +101,11 @@ class Watchdog:
             snap["comm_ledger"] = comm_ledger.snapshot()
         except Exception as e:  # noqa: BLE001
             snap["comm_ledger_error"] = f"{type(e).__name__}: {e}"
+        if extra:
+            snap.update(extra)
         with self._lock:
             self.last_snapshot = snap
+            self.snapshots.append(snap)
         payload = json.dumps(snap, default=str)
         if self.snapshot_path is not None:
             try:

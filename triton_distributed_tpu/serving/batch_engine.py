@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import json
 import time
 
 import jax
@@ -54,12 +55,25 @@ import numpy as np
 from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.models.sampling import finite_logits_mask, sample_token
 from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.obs.blackbox import Blackbox
+from triton_distributed_tpu.obs.slo import (
+    BREACH,
+    STATE_LEVEL,
+    SLOEngine,
+    default_serving_slo,
+)
+from triton_distributed_tpu.obs.trace import TailSampler
 from triton_distributed_tpu.resilience import faults as _faults
 from triton_distributed_tpu.resilience import guards as _guards
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
 from triton_distributed_tpu.serving.prefix_cache import RadixPrefixCache
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
+
+# The trailing windows every stats snapshot reports ("last 10 s" for the
+# live dashboard's now-view, "last 5 min" for trends) over these series.
+_SNAPSHOT_WINDOWS = ((10.0, "10s"), (300.0, "5m"))
+_SNAPSHOT_SERIES = ("ttft_s", "tbt_s", "queue_wait_s")
 
 
 @dataclasses.dataclass
@@ -117,6 +131,20 @@ class BatchEngine:
                    have computed IS the cached KV, token for token).
                    ``engine.prefix_cache.enabled = False`` toggles it off
                    at runtime without touching compiled state.
+
+    Always-on observability (bounded, defaults ON — bench --serve --slo
+    gates the total at <= 5% step-time overhead vs all three off):
+    ``windowed_metrics`` feed every counter/histogram into trailing-window
+                   rings so ``stats_snapshot()`` and the SLO engine can
+                   answer "p99 over the last 10 s / 5 min".
+    ``blackbox``   flight recorder of structured lifecycle events
+                   (admit/preempt/finish/quarantine/fault/SLO); True =
+                   default capacity, an int = that capacity, False = off.
+    ``tail_sampling`` per-request trace sampling that always keeps
+                   slow/errored requests plus a deterministic head-sampled
+                   fraction; pass a configured ``TailSampler`` or False.
+    ``attach_slo()`` adds the OK/WARN/BREACH state machine on top; a
+                   BREACH fires the attached watchdog's snapshot path.
     """
 
     def __init__(self, engine: Engine, *, n_slots: int = 8,
@@ -125,7 +153,9 @@ class BatchEngine:
                  seed: int = 0, admission_pressure: float = 0.0,
                  retry: _guards.RetryPolicy | None = None,
                  nan_guard: bool = False, paged_attn: str = "fused",
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True, windowed_metrics: bool = True,
+                 blackbox: bool | int = True,
+                 tail_sampling: bool | TailSampler = True):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
                 f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
@@ -144,7 +174,27 @@ class BatchEngine:
                            block_size=block_size, max_seq_len=max_seq_len,
                            mesh=engine.mesh, axis=engine.model.axis)
         self.scheduler = Scheduler()
-        self.metrics = Metrics()
+        self.metrics = Metrics(windowed=windowed_metrics)
+        if blackbox:
+            cap = blackbox if isinstance(blackbox, int) \
+                and not isinstance(blackbox, bool) else 1024
+            self.blackbox = Blackbox(capacity=cap)
+        else:
+            self.blackbox = None
+        # The scheduler reports its own decisions (admit batches) into the
+        # same flight recorder — pure data, no import cycle.
+        self.scheduler.event_sink = (self.blackbox.record
+                                     if self.blackbox is not None else None)
+        if isinstance(tail_sampling, TailSampler):
+            self.sampler = tail_sampling
+        else:
+            self.sampler = TailSampler(seed=seed) if tail_sampling else None
+        self._slo = None
+        self._slo_eval_interval_s = 1.0
+        self._slo_next_eval = 0.0
+        self._stats_stream = None
+        self._stats_interval_s = 1.0
+        self._stats_next_emit = 0.0
         self.prefix_cache = (RadixPrefixCache(self.pool,
                                               metrics=self.metrics)
                              if prefix_cache else None)
@@ -247,11 +297,141 @@ class BatchEngine:
                 monitor=monitor)
         return wd
 
+    def attach_slo(self, objectives=None, *,
+                   eval_interval_s: float = 1.0) -> SLOEngine:
+        """Attach the OK/WARN/BREACH state machine: ``objectives`` (default
+        ``obs.slo.default_serving_slo()``) are evaluated every
+        ``eval_interval_s`` seconds of serving-loop time, piggybacked on
+        ``step()`` — no threads. Transitions land in metrics
+        (``slo_state{objective=}`` gauges, ``slo_transitions`` counters),
+        the blackbox, and the tracer; a transition INTO BREACH increments
+        ``slo_breaches`` and fires the attached watchdog's ``snapshot``
+        (reason ``slo-breach:<objective>``) so an SLO violation produces
+        the full forensic bundle. Requires windowed metrics."""
+        if not self.metrics.windowed:
+            raise ValueError("attach_slo needs windowed metrics — construct "
+                             "BatchEngine(windowed_metrics=True)")
+        if objectives is None:
+            objectives = default_serving_slo()
+        self._slo = SLOEngine(objectives, self.metrics,
+                              on_transition=self._on_slo_transition)
+        self._slo_eval_interval_s = float(eval_interval_s)
+        self._slo_next_eval = 0.0
+        return self._slo
+
+    @property
+    def slo(self) -> SLOEngine | None:
+        return self._slo
+
+    def _on_slo_transition(self, obj, old: str, new: str, detail: dict):
+        self.metrics.inc("slo_transitions",
+                         labels={"objective": obj.name, "to": new})
+        self.metrics.set_gauge("slo_state", STATE_LEVEL[new],
+                               labels={"objective": obj.name})
+        if self.blackbox is not None:
+            self.blackbox.record("slo", objective=obj.name, old=old,
+                                 new=new, fast=detail["fast"]["value"],
+                                 slow=detail["slow"]["value"])
+        _trace.instant("slo_transition", objective=obj.name, old=old,
+                       new=new)
+        if new == BREACH:
+            self.metrics.inc("slo_breaches")
+            if self._watchdog is not None:
+                self._watchdog.snapshot(
+                    f"slo-breach:{obj.name}",
+                    extra={"slo_detail": detail})
+
+    def stream_stats(self, path: str, *, interval_s: float = 1.0) -> None:
+        """Append one ``stats_snapshot()`` JSON line to ``path`` every
+        ``interval_s`` seconds of serving-loop time (piggybacked on
+        ``step()``) — the feed ``tools/serve_top.py --stats-jsonl``
+        tails. Pass ``path=None`` to stop."""
+        self._stats_stream = path
+        self._stats_interval_s = float(interval_s)
+        self._stats_next_emit = 0.0
+
+    def _obs_tick(self):
+        """Per-step observability housekeeping: SLO evaluation and the
+        stats stream, each on its own interval. One monotonic read and two
+        comparisons when neither is due."""
+        if self._slo is None and self._stats_stream is None:
+            return
+        now = time.monotonic()
+        if self._slo is not None and now >= self._slo_next_eval:
+            self._slo_next_eval = now + self._slo_eval_interval_s
+            self._slo.evaluate(now)
+        if self._stats_stream is not None and now >= self._stats_next_emit:
+            self._stats_next_emit = now + self._stats_interval_s
+            with open(self._stats_stream, "a") as f:
+                f.write(json.dumps(self.stats_snapshot(), default=str)
+                        + "\n")
+
+    def _window_summary(self) -> dict:
+        """Trailing-window latency stats over the snapshot windows (empty
+        when the registry isn't windowed)."""
+        if not self.metrics.windowed:
+            return {}
+        out: dict = {}
+        for w_s, label in _SNAPSHOT_WINDOWS:
+            d = {}
+            for name in _SNAPSHOT_SERIES:
+                w = self.metrics.window(name, w_s)
+                if w:
+                    d[name] = w
+            out[label] = d
+        return out
+
+    def stats_snapshot(self) -> dict:
+        """One JSON-able frame of live serving state — what ``serve_top``
+        renders and ``stream_stats`` emits: occupancy, pool, throughput
+        counters, trailing-window percentiles, SLO verdicts, and the
+        bounded-telemetry drop counters."""
+        m = self.metrics.as_dict()
+        tracer_dropped = _trace.dropped_spans()
+        self.metrics.set_gauge("trace_dropped_spans", tracer_dropped)
+        snap = {
+            "t": round(time.monotonic(), 3),
+            "wall_time": round(time.time(), 3),
+            "slots": {
+                "active": sum(s is not None for s in self._slots),
+                "total": self.n_slots,
+            },
+            "queue_depth": len(self.scheduler),
+            "pool": {"n_blocks": self.pool.n_blocks,
+                     "n_free": self.pool.n_free,
+                     "n_used": self.pool.n_used,
+                     "n_cached": self.pool.n_cached,
+                     "n_reclaimable": self.pool.n_reclaimable},
+            "counters": {k: m.get(k, 0.0) for k in (
+                "requests_admitted", "requests_completed",
+                "requests_failed", "tokens_generated", "preemptions",
+                "admission_backpressure", "slo_breaches")},
+            "windows": self._window_summary(),
+            "trace_dropped_spans": tracer_dropped,
+        }
+        lookups = m.get("prefix_lookups", 0.0)
+        if lookups:
+            snap["prefix_hit_rate"] = round(
+                m.get("prefix_hits", 0.0) / lookups, 4)
+        if self._slo is not None:
+            snap["slo"] = {"states": self._slo.verdicts(),
+                           "breaches": self._slo.n_breaches}
+        if self.blackbox is not None:
+            snap["blackbox"] = {"len": len(self.blackbox),
+                                "recorded": self.blackbox.n_recorded,
+                                "dropped": self.blackbox.n_dropped}
+        if self.sampler is not None:
+            snap["sampler"] = self.sampler.stats()
+        return snap
+
     def resilience_snapshot(self) -> dict:
-        """Diagnostic snapshot: metrics, pool/queue stats, and the
-        in-flight request table — what the watchdog dumps on breach."""
+        """Diagnostic snapshot: metrics, pool/queue stats, the in-flight
+        request table, and (when the always-on telemetry is enabled) the
+        forensic bundle an SLO/watchdog breach needs — the blackbox event
+        ring, trailing-window percentiles, SLO summary, and the sampled
+        traces of the offending (slow/errored) requests."""
         plan = _faults.get_plan()
-        return {
+        out = {
             "in_flight": [
                 {"slot": i, "req_id": s.req.req_id,
                  "phase": "prefill" if s.prefilling else "decode",
@@ -271,6 +451,18 @@ class BatchEngine:
             "faults_fired": plan.n_fired if plan is not None else 0,
             "metrics": self.metrics.as_dict(),
         }
+        windows = self._window_summary()
+        if windows:
+            out["windows"] = windows
+        if self._slo is not None:
+            out["slo"] = self._slo.summary()
+        if self.blackbox is not None:
+            out["blackbox"] = self.blackbox.dump(last=256)
+        if self.sampler is not None:
+            out["sampler"] = self.sampler.stats()
+            out["sampled_traces"] = [rt.as_dict() for rt in
+                                     list(self.sampler.kept)[-8:]]
+        return out
 
     def perfdb_sample(self) -> dict:
         """Flat metric dict for the perf flight recorder (obs/perfdb.py):
@@ -353,6 +545,9 @@ class BatchEngine:
             self.metrics.inc("step_retries")
             _trace.instant("fault_retry", site=site, attempt=attempt_i,
                            error=str(exc))
+            if self.blackbox is not None:
+                self.blackbox.record("fault", site=site,
+                                     attempt=attempt_i, error=str(exc))
 
         def on_recovery(seconds):
             self.metrics.inc("step_recoveries")
@@ -447,6 +642,9 @@ class BatchEngine:
         self.scheduler.submit(req)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
                            max_new_tokens=max_new_tokens)
+        if self.sampler is not None:
+            self.sampler.begin(req_id, prompt_len=len(prompt),
+                               max_new_tokens=max_new_tokens)
         return req_id
 
     def _admit(self):
@@ -469,6 +667,10 @@ class BatchEngine:
             self.metrics.inc("admission_backpressure")
             _trace.instant("backpressure", waiting=len(self.scheduler),
                            pool_free=self.pool.n_free)
+            if self.blackbox is not None:
+                self.blackbox.record("backpressure",
+                                     waiting=len(self.scheduler),
+                                     pool_free=self.pool.n_free)
             return
         if _faults._PLAN is not None:
             try:
@@ -535,6 +737,14 @@ class BatchEngine:
                                      time.monotonic() - req.submit_t)
             _trace.instant("admit", req=req.req_id, ctx_len=len(ctx),
                            cached=matched, readmit=req.n_preemptions > 0)
+            if self.blackbox is not None:
+                self.blackbox.record("admit", req=req.req_id,
+                                     ctx_len=len(ctx), cached=matched,
+                                     readmit=req.n_preemptions > 0)
+            if self.sampler is not None:
+                self.sampler.event(req.req_id, "admit", ctx_len=len(ctx),
+                                   cached=matched,
+                                   readmit=req.n_preemptions > 0)
 
     def _preempt(self, idx: int):
         s = self._slots[idx]
@@ -545,6 +755,12 @@ class BatchEngine:
         self.metrics.inc("preemptions")
         _trace.instant("preempt", req=s.req.req_id, slot=idx,
                        progress=s.offset)
+        if self.blackbox is not None:
+            self.blackbox.record("preempt", req=s.req.req_id, slot=idx,
+                                 progress=s.offset)
+        if self.sampler is not None:
+            self.sampler.event(s.req.req_id, "preempt", slot=idx,
+                               progress=s.offset)
 
     def _ensure_or_preempt(self, idx: int) -> bool:
         """Grow slot ``idx``'s table for its next token write, evicting
@@ -594,10 +810,19 @@ class BatchEngine:
         self._slots[idx] = None
         self._finished[s.req.req_id] = s.req
         self.metrics.inc("requests_completed")
-        self.metrics.observe("e2e_latency_s", s.req.finish_t - s.req.submit_t)
+        e2e = s.req.finish_t - s.req.submit_t
+        self.metrics.observe("e2e_latency_s", e2e)
         _trace.async_end("request", s.req.req_id,
                          tokens=len(s.req.output),
                          preemptions=s.req.n_preemptions)
+        if self.blackbox is not None:
+            self.blackbox.record("finish", req=s.req.req_id,
+                                 tokens=len(s.req.output),
+                                 preemptions=s.req.n_preemptions,
+                                 e2e_s=round(e2e, 6))
+        if self.sampler is not None:
+            self.sampler.finish(s.req.req_id, latency_s=e2e,
+                                tokens=len(s.req.output))
 
     def _quarantine(self, idx: int, reason: str):
         """Fail ONE request without failing the batch: release its blocks,
@@ -622,22 +847,40 @@ class BatchEngine:
                        reason=reason)
         _trace.async_end("request", req.req_id, tokens=len(req.output),
                          failed=True, error=reason)
+        if self.blackbox is not None:
+            self.blackbox.record("quarantine", req=req.req_id, slot=idx,
+                                 reason=reason)
+        if self.sampler is not None:
+            self.sampler.finish(req.req_id, error=reason,
+                                tokens=len(req.output))
 
     def _record_token(self, s: _Slot, tok: int):
         s.req.output.append(tok)
         s.last_tok = tok
         self.metrics.inc("tokens_generated")
         now = time.monotonic()
+        gap = None
         if s.req.first_token_t is None:
             s.req.first_token_t = now
-            self.metrics.observe("ttft_s", now - s.req.submit_t)
+            gap = now - s.req.submit_t
+            self.metrics.observe("ttft_s", gap)
             _trace.instant("first_token", req=s.req.req_id)
+            if self.sampler is not None:
+                self.sampler.event(s.req.req_id, "first_token",
+                                   ttft_s=round(gap, 6))
         elif s.last_token_t is not None:
             # Inter-token latency within one residency; the slot-local
             # timestamp resets on preemption so the requeue gap lands in
             # queue_wait/preemption accounting, not TBT.
-            self.metrics.observe("tbt_s", now - s.last_token_t)
+            gap = now - s.last_token_t
+            self.metrics.observe("tbt_s", gap)
         s.last_token_t = now
+        # Tail-keep a straggler THE MOMENT one token blows the slow
+        # threshold: a breach snapshot taken while it is still in flight
+        # already contains its trace.
+        if (self.sampler is not None and self.sampler.slow_s is not None
+                and gap is not None and gap > self.sampler.slow_s):
+            self.sampler.mark_slow(s.req.req_id, slow_gap_s=round(gap, 6))
 
     # -- iteration ----------------------------------------------------------
 
@@ -659,6 +902,10 @@ class BatchEngine:
                                self.pool.n_reclaimable)
         self.metrics.set_gauge("pool_occupancy",
                                self.pool.n_used / self.pool.n_blocks)
+        # SLO evaluation + stats stream run even on idle iterations — an
+        # engine starved by a fault is exactly when the SLO must keep
+        # evaluating.
+        self._obs_tick()
         if not active:
             return False
         run = (self._run_mixed

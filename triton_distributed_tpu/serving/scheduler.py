@@ -88,6 +88,10 @@ class Scheduler:
         # After this many evictions a request ages out of the victim pool
         # (see module docstring). None disables aging.
         self.preemption_cap = preemption_cap
+        # Optional ``(kind, **fields)`` callable (the batch engine wires the
+        # blackbox's ``record``): scheduling decisions land in the same
+        # flight recorder as the request lifecycle. None = off.
+        self.event_sink = None
 
     def __len__(self) -> int:
         return len(self._heap)
@@ -164,6 +168,10 @@ class Scheduler:
             _trace.instant("schedule_admit", admitted=len(admitted),
                            waiting=len(self._heap), free_slots=free_slots,
                            blocks_left=budget)
+        if admitted and self.event_sink is not None:
+            self.event_sink("schedule_admit", admitted=len(admitted),
+                            waiting=len(self._heap),
+                            free_slots=free_slots, blocks_left=budget)
         return admitted
 
     @staticmethod
