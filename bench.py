@@ -905,18 +905,42 @@ def main():
     # transient step/allocator errors + NaN-poisoned logit rows). Reports
     # GOODPUT (tokens of successful requests only), failure accounting,
     # and recovery latency. Same ONE-JSON-line stdout contract.
-    if "--chaos" in sys.argv:
+    if "--chaos" in sys.argv or "--chaos-fleet" in sys.argv:
         model = "qwen3-1.7b"
         if "--chaos-model" in sys.argv:
             model = sys.argv[sys.argv.index("--chaos-model") + 1]
         seed = 0
         if "--chaos-seed" in sys.argv:
             seed = int(sys.argv[sys.argv.index("--chaos-seed") + 1])
+        if "--chaos-fleet" in sys.argv:
+            # --chaos-fleet [--chaos-replicas N]: router-scope chaos — a
+            # seeded kill of one of N replicas; goodput/recovery/requeue
+            # counts land as ONE perfdb suite (serve_chaos_fleet).
+            n_replicas = 3
+            if "--chaos-replicas" in sys.argv:
+                n_replicas = int(
+                    sys.argv[sys.argv.index("--chaos-replicas") + 1])
+            try:
+                result = _bench_serve_chaos_fleet(model, seed=seed,
+                                                  n_replicas=n_replicas)
+            except Exception as e:  # noqa: BLE001
+                # The error line keeps the one-JSON-line contract, but the
+                # ARM CRASHING is a failure — exit non-zero so CI sees it.
+                print(json.dumps({"chaos_error":
+                                  f"{type(e).__name__}: {str(e)[:160]}"}))
+                raise SystemExit(1)
+            print(json.dumps(result))
+            _record_perfdb({"extras": result}, perfdb_path,
+                           suite="serve_chaos_fleet")
+            return
         try:
             print(json.dumps(_bench_serve_chaos(model, seed=seed)))
         except Exception as e:  # noqa: BLE001
+            # Same contract as above: the structured error line must not
+            # mask the crash behind exit 0.
             print(json.dumps({"chaos_error":
                               f"{type(e).__name__}: {str(e)[:160]}"}))
+            raise SystemExit(1)
         return
     # TDT_BENCH_PROFILE=1 wraps the measurement in the group_profile
     # context (runtime/utils.py — the reference's cross-rank trace-merge
@@ -1643,6 +1667,129 @@ def _bench_serve_chaos(model_name: str = "qwen3-1.7b", *,
         out["chaos_recovery_p95_ms"] = round(m["recovery_s_p95"] * 1e3, 2)
     assert len(ok) + len(be.failed) == n_req, "requests unaccounted for"
     return out
+
+
+def _bench_serve_chaos_fleet(model_name: str = "qwen3-1.7b", *,
+                             seed: int = 0, n_replicas: int = 3) -> dict:
+    """Router-scope chaos arm (``--chaos-fleet``): ``n_replicas``
+    ``BatchEngine`` replicas behind the cache/SLO-aware ``Router``, with a
+    SEEDED permanent kill of one replica mid-run
+    (``resilience.default_fleet_chaos_plan``). The fleet must quarantine
+    the wedged replica, drain it, requeue its requests onto survivors,
+    and finish 100% of the load. Goodput is measured in tokens per FLEET
+    STEP — deterministic, so the recovery math never flakes on wall clock:
+
+      fleet_goodput_pre        mean tokens/step before the quarantine
+      fleet_goodput_recovered  best trailing-window tokens/step after it
+      fleet_recovery_frac      recovered/pre — gated >= (N-1)/N: the
+                               survivors carry their full share
+      fleet_recovery_steps     fleet steps from quarantine until a
+                               trailing window first reaches the (N-1)/N
+                               target (lower is better)
+      fleet_requeues           requests displaced onto survivors
+      fleet_requests_failed    must be 0 — every non-quarantined request
+                               completes
+      fleet_retraces           sum over replicas; must be 0 (the {1,1}
+                               compile contract holds per replica through
+                               the whole kill/drain/requeue cycle)
+    """
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import (
+        default_fleet_chaos_plan,
+        faults,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import DEAD, Fleet
+
+    if n_replicas < 3:
+        raise ValueError("--chaos-fleet needs >= 3 replicas (the recovery "
+                         "gate compares survivors against (N-1)/N)")
+    config = ModelConfig.from_name(model_name, max_length=512)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="dist",
+                    key=jax.random.PRNGKey(0))
+    fleet = Fleet.build(engine, n_replicas=n_replicas, n_slots=4,
+                        n_blocks=4 * 8, block_size=16, prefill_chunk=64,
+                        max_seq_len=512, fail_threshold=2)
+    rng = np.random.default_rng(0)   # request mix fixed; seed moves FAULTS
+    n_req = 8 * n_replicas
+    for _ in range(n_req):
+        prompt = rng.integers(0, config.vocab_size,
+                              size=int(rng.integers(16, 64))).tolist()
+        fleet.submit(prompt, max_new_tokens=int(rng.integers(24, 48)))
+
+    plan = default_fleet_chaos_plan(seed, kill_replica=seed % n_replicas,
+                                    kill_after=6)
+    tok_per_step: list[float] = []
+    last = 0.0
+    t0 = time.perf_counter()
+    with faults.plan(plan):
+        for _ in range(20000):
+            busy = fleet.step()
+            total = sum(rep.engine.metrics.as_dict().get(
+                "tokens_generated", 0.0) for rep in fleet.replicas)
+            tok_per_step.append(total - last)
+            last = total
+            if (not busy and not fleet.pending
+                    and all(rep.empty or rep.state == DEAD
+                            for rep in fleet.replicas)):
+                break
+    wall_s = time.perf_counter() - t0
+    fleet.check_invariants()
+    ok = fleet.finished
+    failed = fleet.failed
+    assert len(ok) + len(failed) == n_req, "requests unaccounted for"
+    assert not failed, (
+        f"{len(failed)} non-quarantined requests failed under the fleet "
+        f"kill: {sorted(str(k) for k in failed)}")
+    assert any(rep.state == DEAD for rep in fleet.replicas), \
+        "the seeded kill never took a replica down"
+    retraces = sum(rep.engine.trace_counts["decode"]
+                   + rep.engine.trace_counts["prefill"] - 2
+                   for rep in fleet.replicas)
+    assert retraces == 0, f"fleet chaos retraced ({retraces})"
+
+    # tok_per_step[i] is fleet step i+1 (n_steps is 1-based). Pre-kill
+    # rate skips the compile-heavy first step; recovery scans trailing
+    # windows from the quarantine step forward.
+    q_step = next(e["step"] for e in fleet.state_log
+                  if e["to"] == "QUARANTINED")
+    pre = tok_per_step[1:q_step - 1] or tok_per_step[:q_step]
+    pre_rate = sum(pre) / max(len(pre), 1)
+    target = pre_rate * (n_replicas - 1) / n_replicas
+    W = 4
+    recovered = 0.0
+    recovery_steps = None
+    for i in range(q_step - 1, max(q_step - 1, len(tok_per_step) - W + 1)):
+        rate = sum(tok_per_step[i:i + W]) / W
+        recovered = max(recovered, rate)
+        if recovery_steps is None and rate >= target:
+            recovery_steps = i + W - (q_step - 1)
+    assert recovery_steps is not None and recovery_steps <= 60, (
+        f"goodput never recovered to (N-1)/N={target:.1f} tok/step within "
+        f"60 steps of the quarantine (best {recovered:.1f})")
+    fm = fleet.metrics.as_dict()
+    return {
+        "chaos_seed": seed,
+        "fleet_replicas": n_replicas,
+        "fleet_requests_ok": len(ok),
+        "fleet_requests_failed": len(failed),
+        "fleet_goodput_pre": round(pre_rate, 2),
+        "fleet_goodput_recovered": round(recovered, 2),
+        "fleet_recovery_frac": round(recovered / pre_rate, 4)
+        if pre_rate else 0.0,
+        "fleet_recovery_steps": recovery_steps,
+        "fleet_requeues": int(fm.get("requeues", 0.0)),
+        "fleet_requeue_exhausted": int(fm.get("requeue_exhausted", 0.0)),
+        "fleet_quarantines": int(fm.get("replica_quarantines", 0.0)),
+        "fleet_steps": fleet.n_steps,
+        "fleet_goodput_tokens_per_s": round(last / wall_s, 1),
+        "fleet_retraces": retraces,
+        "fleet_faults_injected": plan.n_fired,
+    }
 
 
 def _bench_e2e_subprocess(model_name: str) -> dict:

@@ -18,6 +18,15 @@ DEGRADATION instead of full completion: the engine must finish the run
 (no crash, no retrace), every submitted request must end as either
 completed or quarantined-with-error, at least one request of each kind
 must exist, and the pool must still drain clean.
+
+``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
+N replicas behind the cache/SLO-aware router. Plain run: everything
+completes, no replica leaves the ROUTABLE states, every replica's two
+steps compiled at most once. With ``--chaos``, a seeded kill
+(``resilience.default_fleet_chaos_plan``) wedges one replica and the run
+asserts the fleet contract instead: >= 1 replica quarantined AND 100% of
+the survivor-served requests complete, requeues stay within budget, the
+ownership invariants hold, and per-replica ``trace_counts`` stays {1,1}.
 """
 
 from __future__ import annotations
@@ -27,6 +36,137 @@ import json
 import time
 
 import numpy as np
+
+
+def main_fleet(duration_s: float = 30.0, *, rate_hz: float = 4.0,
+               n_replicas: int = 3, n_slots: int = 4,
+               n_blocks: int | None = 12, seed: int = 0,
+               chaos: bool = False, perfdb_path: str | None = None,
+               stats_jsonl: str | None = None) -> dict:
+    """The ``--replicas N`` arm: Poisson load through a ``Fleet`` of N
+    replicas. Plain run asserts full completion with every replica still
+    routable; ``chaos`` installs the seeded replica-kill plan and asserts
+    the fleet contract (>= 1 replica quarantined, 100% of survivor
+    requests ok, ownership invariants hold every step, per-replica
+    ``trace_counts`` stays {1,1}). Raises RuntimeError on violation."""
+    import contextlib
+
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import (
+        default_fleet_chaos_plan,
+        faults,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import DEAD, ROUTABLE, Fleet
+
+    if n_replicas < 2:
+        raise ValueError("--replicas needs >= 2 (use the single-engine "
+                         "path otherwise)")
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny")
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    fleet = Fleet.build(engine, n_replicas=n_replicas, n_slots=n_slots,
+                        n_blocks=n_blocks, block_size=4, prefill_chunk=8,
+                        fail_threshold=2)
+    plan = None
+    plan_ctx = contextlib.nullcontext()
+    if chaos:
+        plan = default_fleet_chaos_plan(seed,
+                                        kill_replica=seed % n_replicas,
+                                        kill_after=8)
+        plan_ctx = faults.plan(plan)
+
+    rng = np.random.default_rng(seed)
+    start = time.monotonic()
+    deadline = start + duration_s
+    next_arrival = start
+    next_stats = 0.0
+    submitted = 0
+    with plan_ctx:
+        while True:
+            now = time.monotonic()
+            if now >= deadline and next_arrival >= deadline:
+                break
+            while next_arrival <= min(now, deadline):
+                prompt = rng.integers(0, config.vocab_size,
+                                      size=int(rng.integers(3, 12))).tolist()
+                fleet.submit(prompt, max_new_tokens=int(rng.integers(2, 8)))
+                submitted += 1
+                next_arrival += float(rng.exponential(1.0 / rate_hz))
+            busy = fleet.step()
+            # The ownership audit runs EVERY step — a request owned by two
+            # replicas or a leaked block must be caught at the step it
+            # happens, not after the drain smoothed it over.
+            fleet.check_invariants()
+            if stats_jsonl and now >= next_stats:
+                next_stats = now + 0.5
+                with open(stats_jsonl, "a") as f:
+                    f.write(json.dumps(fleet.stats_snapshot(),
+                                       default=str) + "\n")
+            if not busy:
+                time.sleep(min(0.02,
+                               max(0.0, next_arrival - time.monotonic())))
+        fleet.run(max_steps=100000)      # drain in-flight + queued work
+    fleet.check_invariants()
+
+    fm = fleet.metrics.as_dict()
+    quarantines = int(fm.get("replica_quarantines", 0.0))
+    completed = len(fleet.finished)
+    failed = len(fleet.failed)
+    if completed + failed != submitted:
+        raise RuntimeError(f"drain incomplete: {completed} ok + {failed} "
+                           f"failed != {submitted} submitted")
+    if chaos:
+        if not quarantines:
+            raise RuntimeError("fleet chaos run quarantined no replica — "
+                               "the seeded kill never bit")
+        if failed:
+            raise RuntimeError(
+                f"{failed} survivor requests failed under the fleet kill "
+                f"(requeue must re-serve every drained request)")
+    else:
+        if failed or quarantines:
+            raise RuntimeError(f"{failed} failed / {quarantines} "
+                               f"quarantined without chaos")
+        if any(rep.state not in ROUTABLE for rep in fleet.replicas):
+            raise RuntimeError("replica left the routable states without "
+                               "chaos")
+    for rep in fleet.replicas:
+        for kind, n in rep.engine.trace_counts.items():
+            if n > 1:
+                raise RuntimeError(
+                    f"replica {rep.idx} {kind} step retraced {n} times — "
+                    "fleet churn must be data, not shape")
+
+    m = {
+        "requests_submitted": submitted,
+        "requests_completed": completed,
+        "requests_failed": failed,
+        "wall_s": round(time.monotonic() - start, 3),
+        "fleet_steps": fleet.n_steps,
+        "replica_states": [rep.state for rep in fleet.replicas],
+        "replicas_dead": sum(rep.state == DEAD for rep in fleet.replicas),
+        "quarantines": quarantines,
+        "requeues": int(fm.get("requeues", 0.0)),
+        "requeue_exhausted": int(fm.get("requeue_exhausted", 0.0)),
+        "faults_injected": plan.n_fired if plan is not None else 0,
+        "state_log": fleet.state_log,
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = fleet.perfdb_sample()
+        sample["requests_submitted"] = float(submitted)
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_fleet_chaos" if chaos
+            else "serve_smoke_fleet",
+            metrics=sample,
+            meta={"duration_s": duration_s, "rate_hz": rate_hz,
+                  "seed": seed, "n_replicas": n_replicas})
+        m["perfdb_run_id"] = rec.run_id
+    return m
 
 
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
@@ -194,6 +334,11 @@ if __name__ == "__main__":
     ap.add_argument("--chaos", action="store_true",
                     help="install the fault plan; assert graceful "
                          "degradation (>=1 quarantined, >=1 completed)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run a FLEET of this many replicas behind the "
+                         "router (>=2); with --chaos, a seeded kill "
+                         "wedges one replica and the run asserts "
+                         "quarantine + 100%% survivor completion")
     ap.add_argument("--perfdb", default=None,
                     help="append this run's TTFT/TBT/throughput sample to "
                          "the PerfDB JSONL at this path (tools/perf_gate.py "
@@ -206,9 +351,22 @@ if __name__ == "__main__":
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        metrics = main(args.duration, rate_hz=args.rate, seed=args.seed,
-                       chaos=args.chaos, perfdb_path=args.perfdb,
-                       slo=args.slo, stats_jsonl=args.stats_jsonl)
+        if args.replicas > 1:
+            if args.slo:
+                # SLO objectives attach per-replica (the fleet health
+                # machine reads them when present) — not a fleet flag yet.
+                raise SystemExit("--slo is a single-engine flag; fleet "
+                                 "replicas attach their own SLO engines")
+            metrics = main_fleet(args.duration, rate_hz=args.rate,
+                                 n_replicas=args.replicas, seed=args.seed,
+                                 chaos=args.chaos,
+                                 perfdb_path=args.perfdb,
+                                 stats_jsonl=args.stats_jsonl)
+        else:
+            metrics = main(args.duration, rate_hz=args.rate,
+                           seed=args.seed, chaos=args.chaos,
+                           perfdb_path=args.perfdb, slo=args.slo,
+                           stats_jsonl=args.stats_jsonl)
     except RuntimeError as e:
         print(f"FAIL: {e}")
         raise SystemExit(1)

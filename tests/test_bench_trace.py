@@ -77,3 +77,24 @@ def test_trace_arm_emits_all_artifacts(tmp_path, monkeypatch):
     assert sc["consistent"]
     assert sc["ag_bytes"] == sc["ag_expected"] > 0
     assert sc["rs_bytes"] == sc["rs_expected"] > 0
+
+
+def test_chaos_arm_crash_exits_nonzero(monkeypatch):
+    """A crashed --chaos arm must EXIT 1 — the structured ``chaos_error``
+    stdout line (one-JSON-line contract) no longer masks the failure
+    behind exit 0, so CI sees a broken resilience arm."""
+    import pytest
+
+    bench = _load()
+    monkeypatch.setenv("TDT_BENCH_FORCE_FULL", "1")
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--chaos",
+                         "--chaos-model", "no-such-model"])
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        with pytest.raises(SystemExit) as exc:
+            bench.main()
+    assert exc.value.code == 1
+    stdout_lines = [ln for ln in out.getvalue().splitlines() if ln.strip()]
+    assert len(stdout_lines) == 1
+    assert "chaos_error" in json.loads(stdout_lines[0])

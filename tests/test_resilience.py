@@ -426,6 +426,45 @@ def test_heartbeat_staleness():
         hb.check()
 
 
+def test_heartbeat_stale_poll_registers_nothing():
+    """``stale()``/``age()`` are PURE polls for an external health machine
+    (the fleet's): they flag staleness without registering a breach or
+    dumping a snapshot — the breach-raising beat()/check() path is
+    untouched."""
+    wd = Watchdog()
+    hb = wd.heartbeat("loop", interval_s=0.05)
+    hb.beat()
+    assert not hb.stale()
+    assert 0.0 <= hb.age() < 0.05
+    time.sleep(0.12)
+    assert hb.stale() and hb.age() > 0.05
+    assert not wd.breaches and not hb._breached
+    with pytest.raises(WatchdogTimeout):    # beat() still escalates
+        hb.beat()
+
+
+def test_heartbeat_stop_monitor_idempotent_and_restartable():
+    """A fleet teardown may stop a heartbeat that never had a monitor, or
+    stop one twice; and a start/stop/start cycle must hand the new thread
+    a FRESH stop flag (not the already-set one)."""
+    wd = Watchdog()
+    hb = wd.heartbeat("loop", interval_s=30.0)
+    hb.stop_monitor()                   # no monitor: a no-op
+    hb.start_monitor()
+    t1 = hb._thread
+    assert t1 is not None and t1.is_alive()
+    hb.start_monitor()                  # already running: same thread
+    assert hb._thread is t1
+    hb.stop_monitor()
+    assert hb._thread is None and not t1.is_alive()
+    hb.stop_monitor()                   # double stop: still a no-op
+    hb.start_monitor()
+    t2 = hb._thread
+    assert t2 is not t1 and t2.is_alive()
+    hb.stop_monitor(join_timeout_s=1.0)
+    assert not t2.is_alive()
+
+
 # -- 8. comm-ledger hooks ---------------------------------------------------
 
 def test_comm_hooks_fire_without_ledger_enabled(setup):

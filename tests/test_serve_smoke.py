@@ -69,6 +69,38 @@ def test_serve_smoke_slo_and_stats_feed(tmp_path):
     assert "slo" in frame and "telemetry" in frame
 
 
+def test_serve_smoke_fleet_chaos(tmp_path):
+    """The --replicas N --chaos contract (ISSUE 11): the seeded replica
+    kill quarantines AT LEAST one replica, EVERY survivor request still
+    completes (requeue-by-recompute re-serves the drained ones, so
+    failed == 0), and no replica retraces. main_fleet raises on any
+    violation; the stats feed renders the serve_top fleet table."""
+    feed = tmp_path / "fleet_stats.jsonl"
+    m = _load().main_fleet(3.0, rate_hz=6.0, n_replicas=3, seed=0,
+                           chaos=True, stats_jsonl=str(feed))
+    assert m["requests_submitted"] > 0
+    assert m["requests_failed"] == 0
+    assert m["requests_completed"] == m["requests_submitted"]
+    assert m["quarantines"] >= 1
+    assert m["replicas_dead"] >= 1
+    assert m["requeues"] >= 0 and m["requeue_exhausted"] == 0
+    assert m["faults_injected"] >= 1
+    # The state log witnesses the full teardown of the killed replica.
+    path = [e["to"] for e in m["state_log"]]
+    assert "QUARANTINED" in path and "DRAINING" in path and "DEAD" in path
+
+    import json
+
+    from tools import serve_top
+
+    lines = feed.read_text().strip().splitlines()
+    assert lines, "fleet stats stream wrote nothing"
+    snap = json.loads(lines[-1])
+    assert "fleet" in snap and len(snap["fleet"]["replicas"]) == 3
+    frame = serve_top.render(snap)
+    assert "fleet" in frame and "routable" in frame
+
+
 def test_serve_smoke_chaos():
     """The --chaos mode's graceful-degradation contract: the engine rides
     out injected transient errors and NaN-poisoned rows, finishing with

@@ -48,8 +48,40 @@ def _fmt_window(label: str, w: dict) -> str:
 _SLO_MARK = {"OK": " ok ", "WARN": "WARN", "BREACH": "BRCH"}
 
 
+def _fleet_lines(fleet: dict) -> list[str]:
+    """The fleet view: one row per replica (health, SLO, queue, prefix hit
+    rate, requeued count) above the aggregate panes. Shown only when the
+    feed comes from ``Fleet.stats_snapshot()`` (a ``fleet`` block)."""
+    lines = [
+        f"  fleet  {fleet.get('routable', 0)}/{fleet.get('n_replicas', 0)}"
+        f" routable   pending={fleet.get('pending', 0)}"
+        f"  requeues={fleet.get('requeues', 0)}"
+        f" (exhausted={fleet.get('requeue_exhausted', 0)})"
+        f"  quarantines={fleet.get('quarantines', 0)}"
+        f"  backpressure={fleet.get('backpressure', 0)}",
+        "    rep  state        slo   queue  active  hit%   requeued  "
+        "tok      done/fail",
+    ]
+    for r in fleet.get("replicas", ()):
+        state = r.get("state", "?")
+        mark = state if state == "HEALTHY" else f"*{state}*"
+        lines.append(
+            f"    {r.get('idx', '?'):>3}  {mark:<11}  "
+            f"{_SLO_MARK.get(r.get('slo', 'OK'), r.get('slo', '?')):<4}  "
+            f"{r.get('queue', 0):>5}  "
+            f"{r.get('active', 0):>3}/{r.get('slots', 0):<3} "
+            f"{100.0 * r.get('prefix_hit_rate', 0.0):5.1f}  "
+            f"{r.get('requeued', 0):>8}  "
+            f"{r.get('tokens', 0):<7}  "
+            f"{r.get('completed', 0)}/{r.get('failed', 0)}")
+        if r.get("reason"):
+            lines.append(f"         └─ {str(r['reason'])[:70]}")
+    return lines
+
+
 def render(snap: dict) -> str:
-    """Render one ``BatchEngine.stats_snapshot()`` dict as a text frame."""
+    """Render one ``BatchEngine.stats_snapshot()`` (or
+    ``Fleet.stats_snapshot()``) dict as a text frame."""
     lines: list[str] = []
     slots = snap.get("slots", {})
     active, total = slots.get("active", 0), max(1, slots.get("total", 1))
@@ -60,6 +92,8 @@ def render(snap: dict) -> str:
     lines.append(
         f"serve_top  wall={snap.get('wall_time', 0.0):.1f}  "
         f"queue={snap.get('queue_depth', 0)}")
+    if "fleet" in snap:
+        lines.extend(_fleet_lines(snap["fleet"]))
     lines.append(
         f"  slots {_bar(active / total)} {active}/{total}    "
         f"pool {_bar(used / n_blocks)} {used}/{n_blocks} used, "

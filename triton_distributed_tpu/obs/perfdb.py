@@ -233,8 +233,13 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 # prefix cache should shrink it, despite the "ratio"/"_cold" spelling).
 # "slo_breach" (bench --serve --slo: breach count under a healthy load)
 # carries no latency spelling at all but more breaches are strictly worse.
+# "recovery_steps" (bench --chaos-fleet: fleet steps from quarantine to
+# the (N-1)/N goodput target) and "requeue" (requests displaced off a
+# drained replica / budget exhaustions) are both costs of a fault — a
+# faster recovery and fewer displacements win.
 _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
-                           "warm_over_cold", "slo_breach")
+                           "warm_over_cold", "slo_breach",
+                           "recovery_steps", "requeue")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",

@@ -36,6 +36,7 @@ from triton_distributed_tpu.resilience.faults import (  # noqa: F401
     FaultSpec,
     TransientFault,
     default_chaos_plan,
+    default_fleet_chaos_plan,
 )
 from triton_distributed_tpu.resilience.guards import (  # noqa: F401
     QuarantineError,
@@ -89,6 +90,6 @@ def uninstall_hooks(*, keep_plan: bool = False) -> None:
 __all__ = [
     "FaultEvent", "FaultPlan", "FaultSpec", "Heartbeat", "QuarantineError",
     "RetryPolicy", "TransientFault", "Watchdog", "WatchdogTimeout",
-    "bad_rows", "default_chaos_plan", "faults", "guards", "install_hooks",
-    "uninstall_hooks", "watchdog",
+    "bad_rows", "default_chaos_plan", "default_fleet_chaos_plan", "faults",
+    "guards", "install_hooks", "uninstall_hooks", "watchdog",
 ]

@@ -21,6 +21,13 @@ Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
                        degrades the admission to a cold prefill
   engine.decode        the batched decode step (serving/batch_engine)
   engine.prefill       the batched mixed/prefill step
+  replica.<idx>.step   one fleet replica's whole engine step
+                       (serving/fleet.py) — fires BEFORE the engine runs,
+                       so an injected kill never half-mutates engine
+                       state; ``replica.*`` hits every replica
+  router.route         fleet request placement (serving/router.py) —
+                       fires before any signal is read, so a faulted
+                       placement defers cleanly to the next step
   comm.<collective>    every host-level collective wrapper in kernels/
                        (via the ``obs.comm_ledger.timed`` hook)
 
@@ -175,6 +182,31 @@ def default_chaos_plan(seed: int = 0, *, error_p: float = 0.08,
     if delay_s > 0.0:
         specs.append(FaultSpec(site="engine.decode", kind="delay",
                                p=error_p, delay_s=delay_s))
+    return FaultPlan(specs, seed=seed)
+
+
+def default_fleet_chaos_plan(seed: int = 0, *, kill_replica: int = 0,
+                             kill_after: int = 4, error_p: float = 0.0,
+                             route_error_p: float = 0.0) -> FaultPlan:
+    """The stock ROUTER-SCOPE chaos plan (``bench.py --chaos-fleet``,
+    ``scripts/serve_smoke.py --replicas N --chaos``): replica
+    ``kill_replica`` wedges PERMANENTLY after its first ``kill_after``
+    fleet steps (p=1.0 from then on — a dead rank, not a flake), so the
+    fleet must quarantine it, drain its requests, and requeue them onto
+    survivors. Optional background noise: ``error_p`` sprinkles transient
+    step faults across EVERY replica (``replica.*``), ``route_error_p``
+    defers placements at the router. Same seed + same call sequence =
+    bit-identical kill schedule (``plan.log`` is the witness)."""
+    specs = [
+        FaultSpec(site=f"replica.{kill_replica}.step", kind="error",
+                  p=1.0, start_after=kill_after),
+    ]
+    if error_p > 0.0:
+        specs.append(FaultSpec(site="replica.*", kind="error", p=error_p,
+                               start_after=1))
+    if route_error_p > 0.0:
+        specs.append(FaultSpec(site="router.route", kind="error",
+                               p=route_error_p, start_after=1))
     return FaultPlan(specs, seed=seed)
 
 

@@ -176,17 +176,23 @@ class Heartbeat:
             self.start_monitor()
 
     def start_monitor(self) -> None:
-        if self._thread is not None:
+        if self._thread is not None and self._thread.is_alive():
             return
+        # Fresh event per start: a stop()/start() cycle must not hand the
+        # new thread an already-set stop flag.
+        self._stop = threading.Event()
         self._thread = threading.Thread(target=self._monitor, daemon=True,
                                         name=f"watchdog-{self.name}")
         self._thread.start()
 
-    def stop_monitor(self) -> None:
+    def stop_monitor(self, *, join_timeout_s: float = 2.0) -> None:
+        """Idempotent monitor shutdown: safe to call repeatedly (and with
+        no monitor running). Joins the thread with a bounded timeout so a
+        caller tearing a fleet down never blocks on a wedged monitor."""
+        thread, self._thread = self._thread, None
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=1.0)
-            self._thread = None
+        if thread is not None:
+            thread.join(timeout=join_timeout_s)
 
     def _monitor(self) -> None:
         while not self._stop.wait(self.interval_s / 4):
@@ -219,3 +225,15 @@ class Heartbeat:
             self._breached = False
             raise WatchdogTimeout(
                 f"{self.name} heartbeat stale (> {self.interval_s}s)")
+
+    def age(self) -> float:
+        """Seconds since the last ``beat()`` (monotonic)."""
+        return time.monotonic() - self._last
+
+    def stale(self) -> bool:
+        """Pure staleness poll: True when the last beat is older than
+        ``interval_s``. Unlike ``check()``/``beat()`` this registers NO
+        breach, dumps NO snapshot, and never raises — it's for a health
+        machine (the fleet's) that polls many heartbeats every step and
+        does its own escalation."""
+        return self.age() > self.interval_s

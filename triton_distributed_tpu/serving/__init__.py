@@ -9,17 +9,34 @@ two fixed-shape compiled steps. See docs/serving.md for the design note.
   Scheduler / Request    — priority-FIFO queue, admission, eviction policy
   BatchEngine            — the compiled decode/mixed steps + serve loop
   RadixPrefixCache       — content-addressed, ref-counted KV block reuse
+  Fleet / Replica        — N replicas + health machine + drain/requeue
+  Router / RouteDecision — cache-/SLO-/load-aware request placement
   Metrics                — counters / gauges / histograms for the above
 """
 
 from triton_distributed_tpu.serving.batch_engine import BatchEngine
+from triton_distributed_tpu.serving.fleet import (
+    DEAD,
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    QUARANTINED,
+    RECOVERED,
+    ROUTABLE,
+    Fleet,
+    Replica,
+)
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Histogram, Metrics
 from triton_distributed_tpu.serving.prefix_cache import (
     PrefixMatch,
     RadixPrefixCache,
 )
+from triton_distributed_tpu.serving.router import RouteDecision, Router
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
 
-__all__ = ["BatchEngine", "KVPool", "PagedKVState", "Histogram", "Metrics",
-           "PrefixMatch", "RadixPrefixCache", "Request", "Scheduler"]
+__all__ = ["BatchEngine", "DEAD", "DEGRADED", "DRAINING", "Fleet",
+           "HEALTHY", "Histogram", "KVPool", "Metrics", "PagedKVState",
+           "PrefixMatch", "QUARANTINED", "RECOVERED", "ROUTABLE",
+           "RadixPrefixCache", "Replica", "Request", "RouteDecision",
+           "Router", "Scheduler"]

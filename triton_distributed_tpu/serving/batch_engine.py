@@ -647,6 +647,74 @@ class BatchEngine:
                                max_new_tokens=max_new_tokens)
         return req_id
 
+    def adopt(self, req: Request) -> object:
+        """Enqueue an EXISTING ``Request`` object — the fleet's placement
+        and requeue endpoint (``serving/fleet.py``). Unlike ``submit``,
+        the Request survives the move: its id, accumulated ``output``,
+        preemption count, and arrival order all carry over, so a requeue
+        after a replica drain is eviction-by-recompute at fleet scope —
+        the new replica re-prefills prompt+output and greedy decoding
+        continues bit-identically. Tracing/async request intervals are the
+        CALLER's job (the fleet opens them once at first submit; the
+        process-global tracer matches this engine's ``async_end``)."""
+        total = req.context_len + max(req.remaining_new, 1)
+        if total > self.pool.max_seq_len:
+            raise ValueError(f"request context ({total}) exceeds pool "
+                             f"max_seq_len ({self.pool.max_seq_len})")
+        if self.pool.blocks_for(total) > self.pool.n_blocks:
+            raise ValueError(f"request needs {self.pool.blocks_for(total)} "
+                             f"blocks; pool has {self.pool.n_blocks} total")
+        if req.submit_t is None:
+            req.submit_t = time.monotonic()
+        self.scheduler.submit(req)
+        if self.sampler is not None:
+            self.sampler.begin(req.req_id, prompt_len=len(req.prompt),
+                               max_new_tokens=req.max_new_tokens,
+                               adopted=True)
+        return req.req_id
+
+    def drain(self, reason: str = "drain") -> list[Request]:
+        """Pull EVERY request out of this engine — occupied slots via the
+        eviction-by-recompute path (blocks released, generated output kept
+        on the Request for re-prefill elsewhere) plus the whole waiting
+        queue — and return them, oldest arrival first. The fleet calls
+        this on a quarantined replica; the engine is left empty (pool
+        invariants intact) and can be stepped or probed safely afterwards.
+        Requests stay ``status='pending'`` — draining is displacement, not
+        failure."""
+        out: list[Request] = []
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self.pool.release(s.req.req_id)
+            s.req.n_preemptions += 1
+            self._slots[i] = None
+            self.metrics.inc("preemptions")
+            self.metrics.inc("drained_requests")
+            _trace.instant("drain", req=s.req.req_id, slot=i,
+                           progress=s.offset, reason=reason)
+            if self.blackbox is not None:
+                self.blackbox.record("drain", req=s.req.req_id, slot=i,
+                                     progress=s.offset, reason=reason)
+            if self.sampler is not None:
+                self.sampler.event(s.req.req_id, "drain", slot=i,
+                                   reason=reason)
+            out.append(s.req)
+        while len(self.scheduler):
+            req = self.scheduler.pop()
+            self.metrics.inc("drained_requests")
+            out.append(req)
+        out.sort(key=lambda r: (r.arrival_seq
+                                if r.arrival_seq is not None else 0))
+        return out
+
+    @property
+    def heartbeat(self):
+        """The serving-loop ``Heartbeat`` attached via ``attach_watchdog``
+        (None when no heartbeat is configured). The fleet health machine
+        polls ``heartbeat.stale()`` through this."""
+        return self._heartbeat
+
     def _admit(self):
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:

@@ -1,0 +1,670 @@
+"""Fault-tolerant serving fleet: N ``BatchEngine`` replicas behind a
+cache- and SLO-aware ``Router``.
+
+One ``BatchEngine`` is an error boundary for REQUESTS (a poisoned slot is
+quarantined, the batch survives), but it is still a single point of
+failure for TRAFFIC: a wedged step, a stale heartbeat, or a sustained SLO
+breach takes down 100% of serving. The fleet generalizes the same
+quarantine idea one level up — from slots to replicas:
+
+  placement   ``Fleet.submit`` queues requests fleet-side; each step the
+              ``Router`` (serving/router.py) places them on the replica
+              with the best live signal bundle: longest cached-prefix
+              ``match_len`` probe, per-replica SLO state (WARN/BREACH
+              shed load), queue depth + free-block headroom.
+  health      a per-replica state machine
+                  HEALTHY -> DEGRADED -> QUARANTINED -> DRAINING -> DEAD
+                       ^         |
+                       +-- RECOVERED (after ``recovery_steps`` clean steps)
+              driven by three independent detectors: consecutive step
+              failures (``fail_threshold``), sustained SLO breach
+              (``breach_quarantine_evals`` consecutive fleet steps at
+              BREACH), and watchdog heartbeat staleness
+              (``Heartbeat.stale()`` — the poll-only probe, no breach
+              registration).
+  drain       a quarantined replica is drained: every in-flight request
+              leaves via the existing eviction-by-recompute path
+              (``BatchEngine.drain`` — blocks released, generated output
+              kept on the ``Request``) and requeues fleet-side for the
+              router to place on a survivor. Requeue is budgeted by a
+              ``RetryPolicy`` (``retries`` moves per request); an
+              exhausted request lands in ``failed`` with the full reason
+              CHAIN (every displacement that led there), never loops.
+  backpressure fleet-wide admission gating: when the ROUTABLE replicas'
+              aggregate (free+reclaimable)/total block headroom drops
+              below ``admission_pressure`` while work is in flight,
+              routing pauses — a dying replica's requeued load must not
+              cascade the survivors into breach. Never applied to an
+              idle fleet (no deadlock).
+
+Determinism: all fleet logic is host-side control flow over the engines'
+existing data-dynamism — no replica ever recompiles (``trace_counts``
+stays {1,1} per replica through kills, drains, and requeues), and under
+greedy sampling a request's output is bit-identical no matter which
+replica (or how many, via recompute) served it: the replicas share one
+model ``Engine`` (same params), and re-prefilling prompt+output is the
+same eviction-by-recompute contract the single-engine scheduler already
+honors. Chaos is seeded: the fleet fires the ``replica.<idx>.step`` fault
+site BEFORE dispatching each replica's step (an injected kill never
+corrupts engine state) and the router fires ``router.route`` before
+reading signals, so ``FaultPlan`` replays bit-identical kill schedules
+(``resilience.faults.default_fleet_chaos_plan``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+
+import numpy as np
+
+from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.obs.slo import STATE_LEVEL
+from triton_distributed_tpu.resilience import faults as _faults
+from triton_distributed_tpu.resilience import guards as _guards
+from triton_distributed_tpu.serving.batch_engine import BatchEngine
+from triton_distributed_tpu.serving.metrics import Metrics
+from triton_distributed_tpu.serving.router import Router
+from triton_distributed_tpu.serving.scheduler import Request
+
+# Replica health states. ROUTABLE replicas accept new placements and get
+# stepped; the rest are on the way out (QUARANTINED drains next step,
+# DRAINING is mid-teardown, DEAD is terminal).
+HEALTHY = "HEALTHY"
+DEGRADED = "DEGRADED"
+RECOVERED = "RECOVERED"
+QUARANTINED = "QUARANTINED"
+DRAINING = "DRAINING"
+DEAD = "DEAD"
+
+ROUTABLE = frozenset({HEALTHY, DEGRADED, RECOVERED})
+_SLO_NAMES = {v: k for k, v in STATE_LEVEL.items()}
+
+
+@dataclasses.dataclass
+class Replica:
+    """One fleet member: a ``BatchEngine`` plus its health bookkeeping."""
+
+    idx: int
+    engine: BatchEngine
+    state: str = HEALTHY
+    consecutive_failures: int = 0
+    breach_streak: int = 0       # consecutive fleet steps at SLO BREACH
+    clean_streak: int = 0        # consecutive clean steps (recovery clock)
+    requeued: int = 0            # requests displaced off this replica
+    last_error: str | None = None
+    quarantine_reason: str | None = None
+
+    @property
+    def active_slots(self) -> int:
+        return sum(s is not None for s in self.engine._slots)
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self.engine.scheduler)
+
+    @property
+    def empty(self) -> bool:
+        return self.active_slots == 0 and self.queue_depth == 0
+
+    def slo_level(self) -> int:
+        """Worst objective state (0 OK / 1 WARN / 2 BREACH); 0 with no SLO
+        engine attached."""
+        slo = self.engine.slo
+        if slo is None:
+            return 0
+        return max((STATE_LEVEL[v] for v in slo.verdicts().values()),
+                   default=0)
+
+    def heartbeat_stale(self) -> bool:
+        """Staleness matters only while the replica HAS work: an idle
+        engine legitimately stops beating (``beat()`` fires per active
+        step), so idle staleness is not a wedge."""
+        hb = self.engine.heartbeat
+        return (hb is not None and self.active_slots > 0 and hb.stale())
+
+
+class Fleet:
+    """N replicas + router + health machine + fleet-side request queue.
+
+    ``engines``        the replica ``BatchEngine``s (index = replica id).
+                       They should share one model ``Engine`` (same
+                       params) so requeue-by-recompute is bit-exact; see
+                       ``Fleet.build``.
+    ``router``         a ``serving.router.Router`` (default one).
+    ``requeue``        ``RetryPolicy`` whose ``retries`` is the per-request
+                       DISPLACEMENT budget (a request survives at most
+                       that many drains before failing with the reason
+                       chain). Backoff fields are unused — requeues are
+                       step-paced, not sleep-paced.
+    ``fail_threshold`` consecutive step failures that quarantine a replica
+                       (the first failure already marks it DEGRADED).
+    ``breach_quarantine_evals`` consecutive fleet steps at SLO BREACH
+                       before the breaching replica is quarantined.
+    ``recovery_steps`` clean steps a DEGRADED replica needs to be marked
+                       RECOVERED (one more clean step -> HEALTHY).
+    ``admission_pressure`` fleet-wide routing backpressure threshold
+                       (fraction of aggregate routable headroom; 0 = off).
+    """
+
+    def __init__(self, engines, *, router: Router | None = None,
+                 requeue: _guards.RetryPolicy | None = None,
+                 fail_threshold: int = 3,
+                 breach_quarantine_evals: int = 3,
+                 recovery_steps: int = 8,
+                 admission_pressure: float = 0.0):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("a fleet needs at least one replica")
+        self.replicas = [Replica(idx=i, engine=e)
+                         for i, e in enumerate(engines)]
+        self.router = Router() if router is None else router
+        self.requeue = (_guards.RetryPolicy(retries=3) if requeue is None
+                        else requeue)
+        self.fail_threshold = fail_threshold
+        self.breach_quarantine_evals = breach_quarantine_evals
+        self.recovery_steps = recovery_steps
+        self.admission_pressure = admission_pressure
+        self.metrics = Metrics(windowed=False)
+        self.n_steps = 0
+        # Fleet-side request plumbing: requests wait here until the router
+        # places them; a drained replica's requests come back here too.
+        self._pending: list[Request] = []
+        self._submitted: dict[object, Request] = {}
+        self._requeues: dict[object, list[str]] = {}
+        self._failed: dict[object, Request] = {}
+        self._req_counter = 0
+        # Fleet-wide arrival stamps: pre-assigning arrival_seq here (not in
+        # a replica's scheduler) keeps FIFO order stable across requeues
+        # AND keeps heap keys unique when requests from different replicas
+        # land in one survivor's queue.
+        self._arrival = itertools.count()
+        self.state_log: list[dict] = []
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def build(cls, engine, *, n_replicas: int = 3, router=None,
+              requeue=None, fail_threshold: int = 3,
+              breach_quarantine_evals: int = 3, recovery_steps: int = 8,
+              admission_pressure: float = 0.0, **batch_engine_kwargs
+              ) -> "Fleet":
+        """N identically-configured replicas over ONE model ``Engine``
+        (shared params — requeue-by-recompute stays bit-exact; each
+        replica still owns its private KVPool/Scheduler/RadixPrefixCache
+        and compiles its own two steps, so ``trace_counts`` is per
+        replica). ``batch_engine_kwargs`` forward to each ``BatchEngine``.
+        """
+        if n_replicas < 1:
+            raise ValueError("n_replicas must be >= 1")
+        engines = [BatchEngine(engine, **batch_engine_kwargs)
+                   for _ in range(n_replicas)]
+        return cls(engines, router=router, requeue=requeue,
+                   fail_threshold=fail_threshold,
+                   breach_quarantine_evals=breach_quarantine_evals,
+                   recovery_steps=recovery_steps,
+                   admission_pressure=admission_pressure)
+
+    # -- request intake -----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
+               req_id=None) -> object:
+        """Queue one request fleet-side; the router places it on the next
+        ``step()``. Returns the request id."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        if not prompt or max_new_tokens < 1:
+            raise ValueError("need a non-empty prompt and max_new_tokens>=1")
+        total = len(prompt) + max_new_tokens
+        # Validate against EVERY replica's geometry up front so a later
+        # requeue can never land on a replica that cannot hold the request.
+        for rep in self.replicas:
+            pool = rep.engine.pool
+            if total > pool.max_seq_len:
+                raise ValueError(
+                    f"prompt+max_new_tokens ({total}) exceeds replica "
+                    f"{rep.idx}'s max_seq_len ({pool.max_seq_len})")
+            if pool.blocks_for(total) > pool.n_blocks:
+                raise ValueError(
+                    f"request needs {pool.blocks_for(total)} blocks; "
+                    f"replica {rep.idx} has {pool.n_blocks} total")
+        if req_id is None:
+            req_id = f"req-{self._req_counter}"
+        if req_id in self._submitted:
+            raise ValueError(f"duplicate req_id {req_id!r}")
+        self._req_counter += 1
+        req = Request(req_id=req_id, prompt=prompt,
+                      max_new_tokens=max_new_tokens, priority=priority,
+                      arrival_seq=next(self._arrival),
+                      submit_t=time.monotonic())
+        self._submitted[req_id] = req
+        self._pending.append(req)
+        _trace.async_begin("request", req_id, prompt_len=len(prompt),
+                           max_new_tokens=max_new_tokens)
+        return req_id
+
+    # -- health machine -----------------------------------------------------
+
+    def _transition(self, rep: Replica, new: str, reason: str) -> None:
+        old, rep.state = rep.state, new
+        self.state_log.append({"step": self.n_steps, "replica": rep.idx,
+                               "from": old, "to": new, "reason": reason})
+        self.metrics.inc("replica_transitions",
+                         labels={"to": new})
+        _trace.instant("replica_state", replica=rep.idx, old=old, new=new,
+                       reason=reason)
+
+    def _quarantine_replica(self, rep: Replica, reason: str) -> None:
+        if rep.state not in ROUTABLE:
+            return
+        rep.quarantine_reason = reason
+        rep.clean_streak = 0
+        self.metrics.inc("replica_quarantines")
+        self._transition(rep, QUARANTINED, reason)
+
+    def _record_failure(self, rep: Replica, exc: Exception) -> None:
+        rep.consecutive_failures += 1
+        rep.clean_streak = 0
+        rep.last_error = f"{type(exc).__name__}: {exc}"
+        self.metrics.inc("replica_step_failures")
+        _trace.instant("replica_step_failure", replica=rep.idx,
+                       failures=rep.consecutive_failures,
+                       error=rep.last_error)
+        if rep.consecutive_failures >= self.fail_threshold:
+            self._quarantine_replica(
+                rep, f"{rep.consecutive_failures} consecutive step "
+                     f"failures (last: {rep.last_error})")
+        elif rep.state in (HEALTHY, RECOVERED):
+            self._transition(rep, DEGRADED,
+                             f"step failure: {rep.last_error}")
+
+    def _update_health(self) -> None:
+        """Poll the passive detectors (heartbeat staleness, SLO state) and
+        run the recovery clock. Step-failure escalation happens inline in
+        ``_step_replicas`` where the exception is caught."""
+        for rep in self.replicas:
+            if rep.state not in ROUTABLE:
+                continue
+            if rep.heartbeat_stale():
+                self._quarantine_replica(
+                    rep, f"heartbeat stale "
+                         f"({rep.engine.heartbeat.age():.1f}s > "
+                         f"{rep.engine.heartbeat.interval_s}s)")
+                continue
+            lvl = rep.slo_level()
+            if lvl >= STATE_LEVEL["BREACH"]:
+                rep.breach_streak += 1
+                rep.clean_streak = 0
+                if rep.breach_streak >= self.breach_quarantine_evals:
+                    self._quarantine_replica(
+                        rep, f"SLO breach sustained for "
+                             f"{rep.breach_streak} steps")
+                elif rep.state in (HEALTHY, RECOVERED):
+                    self._transition(rep, DEGRADED, "SLO breach")
+                continue
+            rep.breach_streak = 0
+            if lvl > 0:
+                rep.clean_streak = 0
+                if rep.state in (HEALTHY, RECOVERED):
+                    self._transition(rep, DEGRADED, "SLO warn")
+                continue
+            # All detectors clean this step: advance the recovery clock.
+            if rep.consecutive_failures:
+                continue          # failing streak still open
+            rep.clean_streak += 1
+            if (rep.state == DEGRADED
+                    and rep.clean_streak >= self.recovery_steps):
+                self._transition(
+                    rep, RECOVERED,
+                    f"{rep.clean_streak} clean steps")
+            elif rep.state == RECOVERED:
+                self._transition(rep, HEALTHY, "recovery confirmed")
+
+    def _drain(self) -> bool:
+        """Tear down quarantined replicas: DRAINING replicas that emptied
+        go DEAD; QUARANTINED replicas drain (requests requeue fleet-side)
+        and become DRAINING. Two phases in this order so DRAINING is
+        observable for at least one full fleet step."""
+        moved = False
+        for rep in self.replicas:
+            if rep.state == DRAINING and rep.empty:
+                self._transition(rep, DEAD, "drained")
+        for rep in self.replicas:
+            if rep.state != QUARANTINED:
+                continue
+            reason = (f"replica {rep.idx} quarantined: "
+                      f"{rep.quarantine_reason}")
+            reqs = rep.engine.drain(reason=reason)
+            hb = rep.engine.heartbeat
+            if hb is not None:
+                hb.stop_monitor()
+            rep.requeued += len(reqs)
+            for req in reqs:
+                self._requeue(req, reason)
+            moved = moved or bool(reqs)
+            self._transition(rep, DRAINING,
+                             f"drained {len(reqs)} request(s)")
+        return moved
+
+    # -- requeue / failure --------------------------------------------------
+
+    def _fail(self, req: Request, reason: str) -> None:
+        chain = self._requeues.get(req.req_id, [])
+        req.status = "failed"
+        req.error = " -> ".join([*chain, reason]) if chain else reason
+        req.finish_t = time.monotonic()
+        self._failed[req.req_id] = req
+        self.metrics.inc("requests_failed")
+        _trace.async_end("request", req.req_id, failed=True,
+                         error=req.error)
+
+    def _requeue(self, req: Request, reason: str) -> None:
+        """Put a displaced request back in the fleet queue, or fail it with
+        the full displacement chain once the ``RetryPolicy`` budget is
+        spent (no infinite drain->requeue loops)."""
+        chain = self._requeues.setdefault(req.req_id, [])
+        chain.append(reason)
+        if len(chain) > self.requeue.retries:
+            self.metrics.inc("requeue_exhausted")
+            self._fail(req, f"requeue budget exhausted "
+                            f"({self.requeue.retries} allowed)")
+            return
+        self._pending.append(req)
+        self.metrics.inc("requeues")
+        _trace.instant("requeue", req=req.req_id, attempt=len(chain),
+                       reason=reason)
+
+    # -- routing ------------------------------------------------------------
+
+    def _signals(self, rep: Replica, tokens: list[int]) -> dict:
+        """The live signal bundle the router scores — see
+        ``Router`` docstring for the schema. The prefix probe degrades to
+        a cold miss under an injected ``cache.lookup`` fault (same policy
+        as the engine's own probe)."""
+        eng = rep.engine
+        match = 0
+        cache = eng.prefix_cache
+        if cache is not None and cache.enabled and len(tokens) > 1:
+            try:
+                match = cache.match_len(tokens, max_len=len(tokens) - 1)
+            except _faults.TransientFault:
+                self.metrics.inc("route_probe_faults")
+                match = 0
+        pool = eng.pool
+        return {
+            "match_frac": match / len(tokens) if tokens else 0.0,
+            "headroom": (pool.n_free + pool.n_reclaimable) / pool.n_blocks,
+            "load": (rep.queue_depth + rep.active_slots) / eng.n_slots,
+            "slo_level": rep.slo_level(),
+        }
+
+    def _backpressured(self, routable: list[Replica]) -> bool:
+        if self.admission_pressure <= 0.0:
+            return False
+        busy = any(rep.active_slots for rep in routable)
+        if not busy:
+            return False          # idle fleet always admits (no deadlock)
+        avail = sum(rep.engine.pool.n_free + rep.engine.pool.n_reclaimable
+                    for rep in routable)
+        total = sum(rep.engine.pool.n_blocks for rep in routable)
+        return avail / total < self.admission_pressure
+
+    def _route_pending(self) -> bool:
+        if not self._pending:
+            return False
+        routable = [rep for rep in self.replicas if rep.state in ROUTABLE]
+        if not routable:
+            if all(rep.state == DEAD for rep in self.replicas):
+                # Terminal: nothing will ever serve these.
+                while self._pending:
+                    self._fail(self._pending.pop(0),
+                               "no routable replicas (fleet dead)")
+            return False
+        if self._backpressured(routable):
+            self.metrics.inc("fleet_backpressure")
+            _trace.instant("fleet_backpressure", waiting=len(self._pending))
+            return False
+        placed = False
+        pending, self._pending = self._pending, []
+        while pending:
+            req = pending.pop(0)
+            tokens = req.prompt + req.output
+            candidates = [(rep.idx, self._signals(rep, tokens))
+                          for rep in routable]
+            try:
+                decision = self.router.route(tokens, candidates)
+            except _faults.TransientFault as e:
+                # Faulted placement defers THIS request and everything
+                # behind it to the next step — degradation, not loss, and
+                # FIFO order is preserved.
+                self.metrics.inc("routes_deferred")
+                _trace.instant("route_deferred", req=req.req_id,
+                               error=str(e))
+                self._pending = [req, *pending]
+                return placed
+            rep = self.replicas[decision.replica]
+            rep.engine.adopt(req)
+            placed = True
+            self.metrics.inc("requests_routed")
+            _trace.instant("route", req=req.req_id, replica=rep.idx,
+                           score=round(decision.score, 4),
+                           match_frac=round(
+                               decision.signals[rep.idx]["match_frac"], 4))
+        return placed
+
+    # -- stepping -----------------------------------------------------------
+
+    def _step_replicas(self) -> bool:
+        """One engine step per routable replica, each behind its
+        ``replica.<idx>.step`` fault site (fired BEFORE the engine runs, so
+        an injected kill never half-mutates engine state — the drained
+        requests recompute from intact ``Request`` objects)."""
+        busy = False
+        for rep in self.replicas:
+            if rep.state not in ROUTABLE:
+                continue
+            try:
+                if _faults._PLAN is not None:
+                    _faults.fire(f"replica.{rep.idx}.step")
+                stepped = rep.engine.step()
+            except Exception as e:  # noqa: BLE001 — replica error boundary
+                self._record_failure(rep, e)
+                continue
+            if rep.consecutive_failures:
+                rep.consecutive_failures = 0
+                self.metrics.inc("replica_recoveries")
+                _trace.instant("replica_recovered", replica=rep.idx)
+            busy = busy or stepped
+        return busy
+
+    def step(self) -> bool:
+        """One fleet iteration: health poll -> drain/teardown -> route ->
+        step every routable replica. Returns False when nothing happened
+        (fleet idle)."""
+        self.n_steps += 1
+        self._update_health()
+        moved = self._drain()
+        routed = self._route_pending()
+        busy = self._step_replicas()
+        return moved or routed or busy
+
+    def run(self, max_steps: int | None = None) -> dict:
+        """Step until idle (or ``max_steps``); returns ``{req_id:
+        [token ids]}`` for every successful request. Failed requests (over
+        requeue budget, engine-level quarantine, dead fleet) are in
+        ``failed`` with reason chains — a chaos run completes instead of
+        crashing."""
+        steps = 0
+        idle = 0
+        while max_steps is None or steps < max_steps:
+            if self.step():
+                idle = 0
+            elif not self._pending and all(
+                    rep.empty or rep.state == DEAD
+                    for rep in self.replicas):
+                break
+            else:
+                idle += 1
+                if idle > 1000:
+                    raise RuntimeError(
+                        "fleet made no progress for 1000 consecutive idle "
+                        "steps (fault plan blocking all routing?)")
+            steps += 1
+        return {rid: list(req.output)
+                for rid, req in self.finished.items()}
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def finished(self) -> dict:
+        out: dict = {}
+        for rep in self.replicas:
+            out.update(rep.engine.finished)
+        return out
+
+    @property
+    def failed(self) -> dict:
+        """Terminal failures: fleet-level (requeue budget, dead fleet) and
+        engine-level (in-slot quarantine), merged."""
+        out = dict(self._failed)
+        for rep in self.replicas:
+            out.update(rep.engine.failed)
+        return out
+
+    @property
+    def pending(self) -> list[Request]:
+        return list(self._pending)
+
+    def requeue_chain(self, req_id) -> list[str]:
+        """The displacement reason chain recorded for ``req_id`` (empty if
+        it was never requeued)."""
+        return list(self._requeues.get(req_id, ()))
+
+    def check_invariants(self) -> bool:
+        """Fleet-wide ownership audit: every replica pool's invariants
+        hold, no request is owned by two replicas (slot or queue), nothing
+        fleet-pending is also replica-owned, and every submitted request
+        is in EXACTLY ONE lifecycle state (pending / owned / finished /
+        failed). Raises ``AssertionError`` on violation."""
+        owner: dict = {}
+        for rep in self.replicas:
+            eng = rep.engine
+            eng.pool.check_invariants()
+            held = ([s.req.req_id for s in eng._slots if s is not None]
+                    + [r.req_id for r in eng.scheduler.pending()])
+            for rid in held:
+                if rid in owner:
+                    raise AssertionError(
+                        f"request {rid} owned by replicas {owner[rid]} "
+                        f"and {rep.idx}")
+                owner[rid] = rep.idx
+        pending_ids = {req.req_id for req in self._pending}
+        both = pending_ids & set(owner)
+        if both:
+            raise AssertionError(
+                f"requests both fleet-pending and replica-owned: "
+                f"{sorted(map(str, both))}")
+        fin, fail = self.finished, self.failed
+        for rid in self._submitted:
+            n = ((rid in owner) + (rid in pending_ids) + (rid in fin)
+                 + (rid in fail))
+            if n != 1:
+                raise AssertionError(
+                    f"request {rid} is in {n} lifecycle states "
+                    f"(owned={rid in owner}, pending={rid in pending_ids},"
+                    f" finished={rid in fin}, failed={rid in fail})")
+        return True
+
+    # -- observability ------------------------------------------------------
+
+    def replica_table(self) -> list[dict]:
+        """One row per replica — what ``serve_top --fleet`` and
+        ``pod_check --fleet`` render."""
+        rows = []
+        for rep in self.replicas:
+            m = rep.engine.metrics.as_dict()
+            lookups = m.get("prefix_lookups", 0.0)
+            rows.append({
+                "idx": rep.idx,
+                "state": rep.state,
+                "slo": _SLO_NAMES.get(rep.slo_level(), "OK"),
+                "queue": rep.queue_depth,
+                "active": rep.active_slots,
+                "slots": rep.engine.n_slots,
+                "prefix_hit_rate": round(
+                    m.get("prefix_hits", 0.0) / lookups, 4) if lookups
+                    else 0.0,
+                "requeued": rep.requeued,
+                "tokens": int(m.get("tokens_generated", 0.0)),
+                "completed": len(rep.engine._finished),
+                "failed": len(rep.engine._failed),
+                "failures": rep.consecutive_failures,
+                "reason": rep.quarantine_reason,
+            })
+        return rows
+
+    def stats_snapshot(self) -> dict:
+        """Fleet frame for ``serve_top``: engine-shaped aggregates (so the
+        existing panes render unchanged) plus the ``fleet`` block with the
+        per-replica health table."""
+        agg_counters: dict = {}
+        pool = {"n_blocks": 0, "n_free": 0, "n_used": 0, "n_cached": 0,
+                "n_reclaimable": 0}
+        active = total_slots = queue = 0
+        for rep in self.replicas:
+            m = rep.engine.metrics.as_dict()
+            for k in ("requests_admitted", "requests_completed",
+                      "requests_failed", "tokens_generated", "preemptions",
+                      "admission_backpressure", "slo_breaches"):
+                agg_counters[k] = agg_counters.get(k, 0.0) + m.get(k, 0.0)
+            for k in pool:
+                pool[k] += getattr(rep.engine.pool, k)
+            active += rep.active_slots
+            total_slots += rep.engine.n_slots
+            queue += rep.queue_depth
+        fm = self.metrics.as_dict()
+        agg_counters["requests_failed"] = (
+            agg_counters.get("requests_failed", 0.0)
+            + fm.get("requests_failed", 0.0))
+        return {
+            "t": round(time.monotonic(), 3),
+            "wall_time": round(time.time(), 3),
+            "slots": {"active": active, "total": total_slots},
+            "queue_depth": queue + len(self._pending),
+            "pool": pool,
+            "counters": agg_counters,
+            "windows": {},
+            "fleet": {
+                "n_replicas": len(self.replicas),
+                "routable": sum(rep.state in ROUTABLE
+                                for rep in self.replicas),
+                "pending": len(self._pending),
+                "requeues": int(fm.get("requeues", 0.0)),
+                "requeue_exhausted": int(fm.get("requeue_exhausted", 0.0)),
+                "quarantines": int(fm.get("replica_quarantines", 0.0)),
+                "backpressure": int(fm.get("fleet_backpressure", 0.0)),
+                "steps": self.n_steps,
+                "replicas": self.replica_table(),
+            },
+        }
+
+    def perfdb_sample(self) -> dict:
+        """Flat fleet metrics for the perf flight recorder — per-replica
+        engine samples aggregate by SUM for counters; ``retraces`` sums so
+        the {1,1}-per-replica compile contract gates as one number (0)."""
+        out: dict = {}
+        for rep in self.replicas:
+            for k, v in rep.engine.perfdb_sample().items():
+                if k.endswith("_ms") or k.startswith("pool_"):
+                    continue      # latency/pool shape is per-replica
+                out[k] = out.get(k, 0.0) + float(v)
+        fm = self.metrics.as_dict()
+        out["requests_failed"] = (out.get("requests_failed", 0.0)
+                                  + fm.get("requests_failed", 0.0))
+        for k in ("requeues", "requeue_exhausted", "replica_quarantines",
+                  "fleet_backpressure", "requests_routed"):
+            out[k] = float(fm.get(k, 0.0))
+        out["n_replicas"] = float(len(self.replicas))
+        out["replicas_dead"] = float(sum(rep.state == DEAD
+                                         for rep in self.replicas))
+        return out

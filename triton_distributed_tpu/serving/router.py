@@ -1,0 +1,128 @@
+"""SLO- and cache-aware request placement across a fleet of replicas.
+
+The router is the fleet's admission brain (serving/fleet.py): given one
+request's token stream and a live signal bundle per routable replica, it
+picks the replica that serves the request best RIGHT NOW. Three signals,
+in the DistServe / cache-aware-routing tradition:
+
+  prefix locality  the longest cached-prefix ``match_len`` probe against
+                   each replica's ``RadixPrefixCache`` — a replica that
+                   already holds most of the prompt's KV skips that much
+                   prefill (PR 9's radix tree makes the probe O(prompt)
+                   and side-effect-free).
+  SLO state        each replica's OK/WARN/BREACH ladder (PR 10's burn-rate
+                   engine). WARN costs a scoring penalty, BREACH a much
+                   larger one: load is SHED from burning replicas before
+                   they breach harder — but never excluded outright, so a
+                   fleet that is entirely in BREACH still places work
+                   (liveness beats shedding).
+  load / headroom  queue depth + occupied slots (normalized by the slot
+                   bank) and free+reclaimable KV-block headroom. Two
+                   equally-warm replicas split traffic by who has room.
+
+Scoring is a plain weighted sum over normalized signals — deliberately
+transparent (every decision is reproducible from the signal dump the
+``RouteDecision`` carries) and deliberately host-side: routing never
+touches compiled state, so a fleet of N replicas still runs N compiled
+step pairs and nothing else.
+
+Resilience: ``route`` fires the ``router.route`` fault site BEFORE reading
+any signal. An injected ``TransientFault`` leaves the request unplaced —
+the fleet defers it to the next step (degradation, not loss), exactly the
+pattern the scheduler's ``sched.admit`` site established.
+
+Determinism: signals in, decision out — no wall clock, no RNG. Ties break
+by least-recently-routed replica (a per-router round-robin clock), then by
+replica index, so identical fleets route identical traffic identically.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from triton_distributed_tpu.resilience import faults as _faults
+
+# Scoring penalty per SLO state level (obs.slo.STATE_LEVEL: OK=0, WARN=1,
+# BREACH=2). WARN sheds load softly — a strong cache hit can still win the
+# warm replica; BREACH is priced above any achievable signal sum, so a
+# breaching replica only receives work when every alternative breaches too.
+DEFAULT_SLO_PENALTY = (0.0, 0.75, 10.0)
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteDecision:
+    """One placement: the chosen replica plus the full per-replica signal
+    and score dump — the reproducibility witness (tests assert on it, the
+    fleet traces it)."""
+
+    replica: int
+    score: float
+    signals: dict            # replica idx -> its signal dict
+    scores: dict             # replica idx -> its score
+
+
+class Router:
+    """Weighted-sum scorer over per-replica signal dicts.
+
+    Signal dict keys (produced by ``Fleet._signals``):
+      ``match_frac``  cached-prefix tokens / request tokens   (0..1)
+      ``headroom``    (free+reclaimable blocks) / n_blocks    (0..1)
+      ``load``        (queue depth + active slots) / n_slots  (0..inf)
+      ``slo_level``   worst objective state (0 OK / 1 WARN / 2 BREACH)
+
+    ``score = w_cache*match_frac + w_headroom*headroom - w_queue*load
+              - slo_penalty[slo_level]``; highest score wins.
+    """
+
+    def __init__(self, *, w_cache: float = 2.0, w_headroom: float = 0.5,
+                 w_queue: float = 1.0,
+                 slo_penalty: tuple = DEFAULT_SLO_PENALTY):
+        if len(slo_penalty) != 3:
+            raise ValueError("slo_penalty needs one entry per SLO state "
+                             "(OK, WARN, BREACH)")
+        self.w_cache = w_cache
+        self.w_headroom = w_headroom
+        self.w_queue = w_queue
+        self.slo_penalty = tuple(float(p) for p in slo_penalty)
+        # Logical last-routed clock per replica key: the deterministic
+        # tie-breaker (least recently routed wins a tie).
+        self._last_routed: dict = {}
+        self._clock = 0
+        self.n_routed = 0
+
+    def score(self, sig: dict) -> float:
+        level = min(max(int(sig.get("slo_level", 0)), 0), 2)
+        return (self.w_cache * float(sig.get("match_frac", 0.0))
+                + self.w_headroom * float(sig.get("headroom", 0.0))
+                - self.w_queue * float(sig.get("load", 0.0))
+                - self.slo_penalty[level])
+
+    def route(self, tokens, candidates) -> RouteDecision | None:
+        """Place one request. ``candidates`` is a list of ``(key,
+        signals)`` pairs for the ROUTABLE replicas (the fleet's health
+        machine already filtered the quarantined/draining/dead ones).
+        Returns None when the candidate list is empty.
+
+        Fault site ``router.route`` fires first — before any signal is
+        read — so an injected fault defers the whole placement with no
+        half-made decision behind it."""
+        if _faults._PLAN is not None:
+            _faults.fire("router.route")
+        if not candidates:
+            return None
+        signals = {key: dict(sig) for key, sig in candidates}
+        scores = {key: self.score(sig) for key, sig in candidates}
+        best_key = None
+        best_rank = None
+        for key, _sig in candidates:
+            # Higher score first; older last-routed stamp first; lower
+            # replica key last — a total, deterministic order.
+            rank = (-scores[key], self._last_routed.get(key, -1), key)
+            if best_rank is None or rank < best_rank:
+                best_rank = rank
+                best_key = key
+        self._clock += 1
+        self._last_routed[best_key] = self._clock
+        self.n_routed += 1
+        return RouteDecision(replica=best_key, score=scores[best_key],
+                             signals=signals, scores=scores)

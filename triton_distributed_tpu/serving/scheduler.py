@@ -108,6 +108,12 @@ class Scheduler:
     def peek(self) -> Request | None:
         return self._heap[0][2] if self._heap else None
 
+    def pending(self) -> list[Request]:
+        """The waiting requests, in heap (not pop) order — a read-only view
+        for ownership audits (``Fleet.check_invariants``) and health
+        tables; never mutates the queue."""
+        return [req for _, _, req in self._heap]
+
     def pop(self) -> Request:
         return heapq.heappop(self._heap)[2]
 

@@ -26,6 +26,16 @@ rendezvous or a hung collective — the classic silent multi-host failure
 mode — becomes a loud exit 2 with a diagnostic snapshot on stderr within
 SECONDS, instead of a job that sits in the queue forever. That makes the
 tool safe to wire into an orchestrator liveness check.
+
+``--fleet [N]`` probes the SERVING layer instead of the pod fabric:
+builds an N-replica ``serving.Fleet`` over a tiny model on this host's
+first device, drives a short request burst through it, and prints one
+health row per replica (state, SLO verdict, queue, slots, prefix hit
+rate, requeue count). Exit 0 = every replica ended ROUTABLE and every
+request completed; exit 2 = a wedged replica (QUARANTINED / DRAINING /
+DEAD), a failed request, or a broken ownership invariant — the fleet
+path is not safe to put behind the router. Composes with
+``--deadline``.
 """
 
 from __future__ import annotations
@@ -148,8 +158,78 @@ def _run_stages(stage) -> int:
     return 0
 
 
+def main_fleet(n_replicas: int = 3, deadline_s: float | None = None) -> int:
+    """Serving-fleet health probe (``--fleet``): N replicas over a tiny
+    model on one local device, a deterministic request burst, then one
+    table row per replica. Exit 2 when any replica is wedged — i.e. left
+    the ROUTABLE set (QUARANTINED / DRAINING / DEAD) — or any request
+    failed, so an orchestrator can gate router registration on it."""
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import ROUTABLE, Fleet
+
+    wd = None
+    probe = contextlib.nullcontext()
+    if deadline_s is not None:
+        from triton_distributed_tpu.resilience import Watchdog
+
+        wd = Watchdog(on_breach="interrupt")
+        probe = wd.deadline("fleet_probe", deadline_s)
+
+    try:
+        with probe:
+            mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                             set_default=False)
+            config = ModelConfig.from_name("tiny")
+            engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+            fleet = Fleet.build(engine, n_replicas=n_replicas, n_slots=2,
+                                n_blocks=16, block_size=4, prefill_chunk=8)
+            log(f"fleet: {n_replicas} replica(s), 2 slots x 16 blocks each")
+            rng = np.random.default_rng(0)
+            for _ in range(2 * n_replicas):
+                prompt = rng.integers(0, config.vocab_size, size=6).tolist()
+                fleet.submit(prompt, max_new_tokens=4)
+            fleet.run(max_steps=10_000)
+            fleet.check_invariants()
+    except BaseException as e:  # noqa: BLE001 — includes the interrupt
+        if wd is None or not wd.breaches:
+            raise
+        log(f"FAIL: deadline breached in fleet probe ({type(e).__name__})")
+        return 2
+
+    log("  rep  state        slo   queue  active/slots  hit%  requeued  "
+        "done/fail")
+    wedged = []
+    for row in fleet.replica_table():
+        log(f"  {row['idx']:>3}  {row['state']:<11}  {row['slo']:<4}  "
+            f"{row['queue']:>5}  {row['active']:>6}/{row['slots']:<5} "
+            f"{100.0 * row['prefix_hit_rate']:5.1f}  "
+            f"{row['requeued']:>8}  {row['completed']}/{row['failed']}")
+        if row["state"] not in ROUTABLE:
+            wedged.append((row["idx"], row["state"], row.get("reason")))
+    failed = fleet.failed
+    for idx, state, reason in wedged:
+        log(f"FAIL: replica {idx} wedged in {state}"
+            + (f" ({reason})" if reason else ""))
+    if failed:
+        log(f"FAIL: {len(failed)} request(s) failed: "
+            + "; ".join(f"{rid}: {why}" for rid, why in
+                        sorted(failed.items())[:3]))
+    if wedged or failed:
+        return 2
+    log(f"FLEET READY ({len(fleet.finished)} probe requests ok)")
+    return 0
+
+
 if __name__ == "__main__":
     deadline = None
     if "--deadline" in sys.argv:
         deadline = float(sys.argv[sys.argv.index("--deadline") + 1])
+    if "--fleet" in sys.argv:
+        i = sys.argv.index("--fleet")
+        n = (int(sys.argv[i + 1]) if i + 1 < len(sys.argv)
+             and sys.argv[i + 1].isdigit() else 3)
+        sys.exit(main_fleet(n, deadline))
     sys.exit(main(deadline))
