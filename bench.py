@@ -789,6 +789,246 @@ def _bench_serve_slo() -> dict:
     }
 
 
+# --- adaptive-control arm (--serve --adaptive) -----------------------------
+#
+# Deterministic virtual-time cost model: one BatchEngine step costs a fixed
+# dispatch term plus per-prefill-token and per-decode-row terms — the real
+# accelerator step-time shape (prefill is compute-bound in consumed tokens;
+# each decode row adds a small fixed cost). All accounting is host-side over
+# integer counters, so a run is bit-reproducible on any backend — which is
+# what lets the controller-beats-every-static gate run in CPU CI without
+# flaking on wall clock.
+_ADAPT_C0 = 1.0
+_ADAPT_CP = 0.05            # per prefill token consumed
+_ADAPT_CD = 0.02            # per decode row
+# Per-class virtual SLO bounds (ttft, tbt) in cost-model units: chat wants
+# a fast first token, long-doc tolerates a slow one; both want steady TBT.
+_ADAPT_BOUNDS = {"chat": (21.0, 2.8), "doc": (28.0, 5.0)}
+# Virtual-TBT monitor: mean step cost over the trailing window while decode
+# rows are present. WARN is what the controller sees; BREACH counts
+# breach_steps (lower-better override in perfdb).
+_ADAPT_TBT_WARN = 2.9
+_ADAPT_TBT_BREACH = 4.5
+# Goodput denominator floor: met tokens per virtual-time unit over a fixed
+# horizon, so finishing early never inflates the score (a config slower
+# than the horizon pays its real elapsed time instead).
+_ADAPT_HORIZON = 180.0
+
+
+def _adaptive_workload(rng, vocab: int) -> list:
+    """Phase-shifting arrival schedule in VIRTUAL time: a chat burst, then
+    a long document phase with chats still landing on top of the doc
+    prefills, then a mixed tail. Each phase has a different optimal
+    prefill budget, so no static config wins everywhere — the premise the
+    adaptive gate tests."""
+    work = []
+    for k in range(12):                       # phase 1: chat burst
+        work.append((1.0 * k, "chat", 16, 4))
+    for k in range(2):                        # phase 2: doc PAIRS...
+        work.append((26.0 + 20.0 * k, "doc", 128, 6))
+        work.append((26.5 + 20.0 * k, "doc", 128, 6))
+    for k in range(13):                       # ...with chats still landing
+        work.append((27.0 + 3.0 * k, "chat", 16, 4))
+    for k in range(6):                        # phase 3: mixed tail
+        work.append((70.0 + 2.5 * k, "chat", 16, 4))
+    work.append((72.0, "doc", 128, 6))
+    work.append((82.0, "doc", 128, 6))
+    work.sort(key=lambda w: (w[0], w[1]))
+    return [(vt, cls, rng.integers(0, vocab, size=plen).tolist(), gen)
+            for vt, cls, plen, gen in work]
+
+
+def _bench_serve_adaptive() -> dict:
+    """The ``--serve --adaptive`` arm: the SLO-driven controller
+    (serving/controller.py) against a static grid on a phase-shifting
+    trace, scored in deterministic virtual time.
+
+    Five runs of the same workload on fresh engines: every static
+    (prefill_budget, admission_pressure) corner of the controller's own
+    knob range, then one controller-driven run (ticked once per step from
+    the virtual-TBT monitor). Headline metric is goodput-under-SLO —
+    generated tokens of requests meeting their class bounds per unit of
+    virtual time — and the gate is strict: the controller must beat EVERY
+    static config, with zero retraces and both compiled steps still {1,1}
+    (every knob move is per-step data). A second controller run must
+    reproduce the first bit-for-bit (action log + goodput) — the
+    determinism witness."""
+    import collections
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine, Controller
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    work = _adaptive_workload(np.random.default_rng(0), config.vocab_size)
+
+    def run_trace(tag, *, budget=None, pressure=None, controlled=False):
+        be = BatchEngine(engine, n_slots=6, n_blocks=80, block_size=8,
+                         prefill_chunk=64, max_seq_len=256,
+                         prefix_cache=False)
+        if budget is not None:
+            be.prefill_budget = int(budget)
+        if pressure is not None:
+            be.admission_pressure = float(pressure)
+        ctl = Controller(engine=be, interval_steps=1, relax_after=8) \
+            if controlled else None
+        vt, nxt = 0.0, 0
+        vt_submit, vt_first, vt_finish = {}, {}, {}
+        cls_of, gen_of = {}, {}
+        recent = collections.deque(maxlen=4)
+        breach_steps = warn_steps = 0
+        prev_pre = prev_dec = 0.0
+        for step_i in range(4000):
+            while nxt < len(work) and work[nxt][0] <= vt:
+                _, cls, prompt, gen = work[nxt]
+                rid = be.submit(prompt, max_new_tokens=gen,
+                                req_id=f"{tag}-{nxt}")
+                vt_submit[rid], cls_of[rid], gen_of[rid] = vt, cls, gen
+                nxt += 1
+            busy = be.step()
+            m = be.metrics.as_dict()
+            pre = m.get("prefill_tokens", 0.0) - prev_pre
+            dec = m.get("decode_rows", 0.0) - prev_dec
+            prev_pre += pre
+            prev_dec += dec
+            cost = _ADAPT_C0 + _ADAPT_CP * pre + _ADAPT_CD * dec
+            vt += cost
+            for s in be._slots:
+                if (s is not None and s.req.output
+                        and s.req.req_id not in vt_first):
+                    vt_first[s.req.req_id] = vt
+            for rid in be._finished:
+                if rid not in vt_finish:
+                    vt_finish[rid] = vt
+                    vt_first.setdefault(rid, vt)
+            level = 0
+            if dec > 0:
+                recent.append(cost)
+                avg = sum(recent) / len(recent)
+                level = (2 if avg > _ADAPT_TBT_BREACH
+                         else 1 if avg > _ADAPT_TBT_WARN else 0)
+            if level == 2:
+                breach_steps += 1
+            elif level == 1:
+                warn_steps += 1
+            if ctl is not None:
+                pre_rows = backlog = dec_rows = 0
+                for s in be._slots:
+                    if s is None:
+                        continue
+                    if s.prefilling:
+                        pre_rows += 1
+                        backlog += len(s.ctx) - s.offset
+                    else:
+                        dec_rows += 1
+                ctl.tick({"queue": len(be.scheduler),
+                          "decode_rows": dec_rows,
+                          "prefill_rows": pre_rows,
+                          "backlog_tokens":
+                              backlog + be.scheduler.backlog_tokens(),
+                          "free_frac": be.pool.headroom_frac,
+                          "level": level, "step": step_i, "dead": ()})
+            if nxt >= len(work) and not busy and not len(be.scheduler):
+                break
+        else:
+            raise RuntimeError(f"adaptive trace [{tag}] never drained")
+        be.pool.check_invariants()
+        if be.trace_counts != {"decode": 1, "prefill": 1}:
+            raise RuntimeError(f"adaptive trace [{tag}] retraced: "
+                               f"{be.trace_counts}")
+        if be.failed:
+            raise RuntimeError(f"adaptive trace [{tag}] failed requests: "
+                               f"{sorted(be.failed)}")
+        met = met_tokens = total_tokens = 0
+        per_cls = {"chat": [0, 0], "doc": [0, 0]}
+        lat = {"chat": [], "doc": []}
+        for rid, t_sub in vt_submit.items():
+            if rid not in vt_finish:
+                raise RuntimeError(f"[{tag}] {rid} never finished")
+            gen = gen_of[rid]
+            ttft = vt_first[rid] - t_sub
+            tbt = (vt_finish[rid] - vt_first[rid]) / max(gen - 1, 1)
+            t_bound, b_bound = _ADAPT_BOUNDS[cls_of[rid]]
+            total_tokens += gen
+            per_cls[cls_of[rid]][1] += 1
+            lat[cls_of[rid]].append((round(ttft, 1), round(tbt, 2)))
+            if ttft <= t_bound and tbt <= b_bound:
+                met += 1
+                met_tokens += gen
+                per_cls[cls_of[rid]][0] += 1
+        return {"tag": tag,
+                "goodput": round(met_tokens / max(vt, _ADAPT_HORIZON), 4),
+                "vt": round(vt, 2), "met": met, "total": len(vt_submit),
+                "met_chat": per_cls["chat"][0],
+                "n_chat": per_cls["chat"][1],
+                "met_doc": per_cls["doc"][0], "n_doc": per_cls["doc"][1],
+                "breach_steps": breach_steps, "warn_steps": warn_steps,
+                "steps": step_i + 1,
+                "actions": ctl.n_actions if ctl else 0,
+                "oscillations": ctl.oscillations if ctl else 0,
+                "lat": lat,
+                "action_log": list(ctl.action_log) if ctl else []}
+
+    statics = {}
+    for b in (8, 64):                       # the budget knob's lo / hi
+        for p in (0.0, 0.3):
+            r = run_trace(f"b{b}-p{p}", budget=b, pressure=p)
+            statics[f"budget{b}_pressure{p}"] = r
+    ctl_res = run_trace("ctl", controlled=True)
+    if os.environ.get("TDT_ADAPT_DEBUG", "0") == "1":
+        import sys as _sys
+        for name, r in list(statics.items()) + [("controller", ctl_res)]:
+            print({k: v for k, v in r.items()
+                   if k not in ("action_log", "lat")}, file=_sys.stderr)
+            print("  doc lat:", r["lat"]["doc"], file=_sys.stderr)
+        for e in ctl_res["action_log"]:
+            print(e, file=_sys.stderr)
+    replay = run_trace("ctl", controlled=True)
+    if (replay["action_log"] != ctl_res["action_log"]
+            or replay["goodput"] != ctl_res["goodput"]):
+        raise RuntimeError("controller replay diverged — decision path "
+                           "is not deterministic")
+    best_tag, best = max(statics.items(),
+                         key=lambda kv: kv[1]["goodput"])
+    if ctl_res["goodput"] <= best["goodput"]:
+        raise RuntimeError(
+            f"controller goodput {ctl_res['goodput']} does not beat best "
+            f"static {best_tag} ({best['goodput']})")
+    if not ctl_res["action_log"]:
+        raise RuntimeError("controller took no actions on the "
+                           "phase-shifting trace")
+    extras = {
+        "adaptive_requests": ctl_res["total"],
+        "adaptive_slo_met": ctl_res["met"],
+        "adaptive_chat_met": ctl_res["met_chat"],
+        "adaptive_doc_met": ctl_res["met_doc"],
+        "breach_steps": ctl_res["breach_steps"],
+        "warn_steps": ctl_res["warn_steps"],
+        "controller_actions": ctl_res["actions"],
+        "controller_oscillations": ctl_res["oscillations"],
+        "adaptive_retraces": 0,
+        "adaptive_replay_identical": True,
+        "goodput_static_best": best["goodput"],
+        "adaptive_win_frac": round(
+            ctl_res["goodput"] / best["goodput"], 4),
+    }
+    for name, r in statics.items():
+        extras[f"goodput_{name}"] = r["goodput"]
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "goodput_under_slo",
+        "value": ctl_res["goodput"],
+        "unit": "tok/vt",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -837,13 +1077,22 @@ def main():
     # timing-sensitive number, and it compares two passes of the same
     # process against each other).
     if "--serve" in sys.argv:
-        # --serve --slo: always-on telemetry overhead arm; plain --serve:
-        # the prefix-cache arm. Same placement rationale for both.
+        # --serve --slo: always-on telemetry overhead arm; --serve
+        # --adaptive: the SLO-driven controller vs the static grid (all
+        # deterministic virtual time, so CPU CI gates it); plain --serve:
+        # the prefix-cache arm. Same placement rationale for all three.
         with_slo = "--slo" in sys.argv
-        metric = "obs_overhead_frac" if with_slo else "prefix_hit_rate"
+        adaptive = "--adaptive" in sys.argv
+        metric = ("goodput_under_slo" if adaptive
+                  else "obs_overhead_frac" if with_slo
+                  else "prefix_hit_rate")
         try:
-            result = _bench_serve_slo() if with_slo \
-                else _bench_serve_prefix()
+            if adaptive:
+                result = _bench_serve_adaptive()
+            elif with_slo:
+                result = _bench_serve_slo()
+            else:
+                result = _bench_serve_prefix()
         except Exception as e:  # noqa: BLE001
             result = {
                 "backend": "error",
@@ -854,7 +1103,9 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path,
-                       suite="serve_slo" if with_slo else "serve_prefix")
+                       suite=("serve_adaptive" if adaptive
+                              else "serve_slo" if with_slo
+                              else "serve_prefix"))
         return
 
     # Backend probe FIRST: everything below (compile cache, device queries)
@@ -915,14 +1166,22 @@ def main():
         if "--chaos-fleet" in sys.argv:
             # --chaos-fleet [--chaos-replicas N]: router-scope chaos — a
             # seeded kill of one of N replicas; goodput/recovery/requeue
-            # counts land as ONE perfdb suite (serve_chaos_fleet).
+            # counts land as ONE perfdb suite (serve_chaos_fleet). With
+            # --adaptive the kill is TRANSIENT and the attached controller
+            # must revive the dead replica back to full N/N capacity
+            # (suite serve_adaptive).
             n_replicas = 3
             if "--chaos-replicas" in sys.argv:
                 n_replicas = int(
                     sys.argv[sys.argv.index("--chaos-replicas") + 1])
+            adaptive = "--adaptive" in sys.argv
             try:
-                result = _bench_serve_chaos_fleet(model, seed=seed,
-                                                  n_replicas=n_replicas)
+                if adaptive:
+                    result = _bench_serve_adaptive_fleet(
+                        model, seed=seed, n_replicas=n_replicas)
+                else:
+                    result = _bench_serve_chaos_fleet(
+                        model, seed=seed, n_replicas=n_replicas)
             except Exception as e:  # noqa: BLE001
                 # The error line keeps the one-JSON-line contract, but the
                 # ARM CRASHING is a failure — exit non-zero so CI sees it.
@@ -931,7 +1190,8 @@ def main():
                 raise SystemExit(1)
             print(json.dumps(result))
             _record_perfdb({"extras": result}, perfdb_path,
-                           suite="serve_chaos_fleet")
+                           suite=("serve_adaptive" if adaptive
+                                  else "serve_chaos_fleet"))
             return
         try:
             print(json.dumps(_bench_serve_chaos(model, seed=seed)))
@@ -1789,6 +2049,137 @@ def _bench_serve_chaos_fleet(model_name: str = "qwen3-1.7b", *,
         "fleet_goodput_tokens_per_s": round(last / wall_s, 1),
         "fleet_retraces": retraces,
         "fleet_faults_injected": plan.n_fired,
+    }
+
+
+def _bench_serve_adaptive_fleet(model_name: str = "qwen3-1.7b", *,
+                                seed: int = 0, n_replicas: int = 3) -> dict:
+    """The ``--chaos-fleet --adaptive`` arm: a TRANSIENT seeded kill
+    (``kill_fires`` bounds the wedge — a rank that rebooted) with the
+    adaptive controller attached at fleet scope. The controller must
+    quarantine-survive the kill like the plain chaos arm AND then bring
+    the dead replica back via ``Fleet.revive()`` once its cooldown passes,
+    returning the fleet to FULL N/N capacity:
+
+      fleet_revives >= 1, every replica ROUTABLE at the end, zero failed
+      requests, zero retraces, and the best post-revive trailing-window
+      goodput (tokens per fleet step — deterministic) recovers to >= 95%
+      of the pre-kill rate. Arrivals are waved (a block up front, then a
+      trickle) so there is live load after the revive for that gate to
+      measure."""
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import (
+        default_fleet_chaos_plan,
+        faults,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import DEAD, ROUTABLE, Fleet
+
+    if n_replicas < 2:
+        raise ValueError("--chaos-fleet --adaptive needs >= 2 replicas "
+                         "(someone must survive the kill)")
+    config = ModelConfig.from_name(model_name, max_length=512)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="dist",
+                    key=jax.random.PRNGKey(0))
+    fleet = Fleet.build(engine, n_replicas=n_replicas, n_slots=4,
+                        n_blocks=4 * 8, block_size=16, prefill_chunk=64,
+                        max_seq_len=512, fail_threshold=2,
+                        revive_cooldown_steps=6)
+    ctl = fleet.attach_controller()
+    rng = np.random.default_rng(0)   # request mix fixed; seed moves FAULTS
+    n_req = 16 * n_replicas
+    reqs = [(rng.integers(0, config.vocab_size,
+                          size=int(rng.integers(16, 64))).tolist(),
+             int(rng.integers(24, 48))) for _ in range(n_req)]
+    head = n_req // 3
+    for p, g in reqs[:head]:
+        fleet.submit(p, max_new_tokens=g)
+    tail = reqs[head:]
+    # kill_fires=fail_threshold: the wedge dies with the replica and never
+    # re-fires after the revive — the revived replica STAYS healthy.
+    plan = default_fleet_chaos_plan(seed, kill_replica=seed % n_replicas,
+                                    kill_after=6, kill_fires=2)
+    tok_per_step: list[float] = []
+    last = 0.0
+    fi = 0
+    t0 = time.perf_counter()
+    with faults.plan(plan):
+        for step_i in range(20000):
+            if step_i % 4 == 0 and fi < len(tail):
+                p, g = tail[fi]
+                fi += 1
+                fleet.submit(p, max_new_tokens=g)
+            busy = fleet.step()
+            total = sum(rep.engine.metrics.as_dict().get(
+                "tokens_generated", 0.0) for rep in fleet.replicas)
+            tok_per_step.append(total - last)
+            last = total
+            if (fi >= len(tail) and not busy and not fleet.pending
+                    and all(rep.empty or rep.state == DEAD
+                            for rep in fleet.replicas)):
+                break
+    wall_s = time.perf_counter() - t0
+    fleet.check_invariants()
+    assert len(fleet.finished) + len(fleet.failed) == n_req, \
+        "requests unaccounted for"
+    assert not fleet.failed, (
+        f"{len(fleet.failed)} requests failed under the transient kill: "
+        f"{sorted(str(k) for k in fleet.failed)}")
+    retraces = sum(rep.engine.trace_counts["decode"]
+                   + rep.engine.trace_counts["prefill"] - 2
+                   for rep in fleet.replicas)
+    assert retraces == 0, f"adaptive fleet retraced ({retraces})"
+    revives = sum(rep.revives for rep in fleet.replicas)
+    assert revives >= 1, (
+        "the controller never revived the dead replica "
+        f"(states: {[rep.state for rep in fleet.replicas]})")
+    assert all(rep.state in ROUTABLE for rep in fleet.replicas), (
+        f"fleet did not return to full capacity: "
+        f"{[rep.state for rep in fleet.replicas]}")
+
+    # Deterministic goodput recovery: best trailing window after the LAST
+    # revive vs the pre-kill rate. n_steps is 1-based; tok_per_step[i] is
+    # fleet step i+1.
+    q_step = next(e["step"] for e in fleet.state_log
+                  if e["to"] == "QUARANTINED")
+    r_step = max(e["step"] for e in fleet.state_log
+                 if e["to"] == "HEALTHY" and "revived" in e["reason"])
+    pre = tok_per_step[1:q_step - 1] or tok_per_step[:q_step]
+    pre_rate = sum(pre) / max(len(pre), 1)
+    W = 6
+    recovered = 0.0
+    for i in range(r_step - 1, max(r_step, len(tok_per_step) - W + 1)):
+        recovered = max(recovered, sum(tok_per_step[i:i + W]) / W)
+    frac = recovered / pre_rate if pre_rate else 0.0
+    assert frac >= 0.95, (
+        f"post-revive goodput {recovered:.1f} tok/step never recovered to "
+        f"95% of the pre-kill rate {pre_rate:.1f}")
+    fm = fleet.metrics.as_dict()
+    return {
+        "chaos_seed": seed,
+        "fleet_replicas": n_replicas,
+        "fleet_requests_ok": len(fleet.finished),
+        "fleet_requests_failed": 0,
+        "fleet_revives": revives,
+        "fleet_goodput_pre": round(pre_rate, 2),
+        "fleet_goodput_revived": round(recovered, 2),
+        "fleet_revival_frac": round(frac, 4),
+        "fleet_revive_step": r_step,
+        "fleet_quarantine_step": q_step,
+        "fleet_requeues": int(fm.get("requeues", 0.0)),
+        "fleet_quarantines": int(fm.get("replica_quarantines", 0.0)),
+        "fleet_steps": fleet.n_steps,
+        "fleet_goodput_tokens_per_s": round(last / wall_s, 1),
+        "fleet_retraces": 0,
+        "fleet_faults_injected": plan.n_fired,
+        "controller_actions": ctl.n_actions,
+        "controller_revives": ctl.n_revives,
+        "controller_oscillations": ctl.oscillations,
+        "controller_act_faults": ctl.n_act_faults,
     }
 
 

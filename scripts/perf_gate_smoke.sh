@@ -28,7 +28,16 @@
 #                                           TPU) with SLO verdicts, bit-
 #                                           identity and 0 retraces hard-
 #                                           checked anywhere
-#   7. tools/perf_gate.py --db ...       -> compare newest vs history,
+#   7. python bench.py --serve --adaptive -> adaptive control plane arm:
+#                                           the controller must beat every
+#                                           static (budget, pressure)
+#                                           config on goodput-under-SLO
+#                                           over the phase-shifting trace
+#                                           (deterministic virtual-time
+#                                           cost model, runs anywhere),
+#                                           with zero retraces and a bit-
+#                                           identical replay
+#   8. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -164,6 +173,32 @@ if ex.get("obs_overhead_gated"):
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_adaptive run $i/2" >&2
+  python bench.py --serve --adaptive --perfdb "$DB" \
+    > "$WORKDIR/serve_adaptive_out.$i.json"
+  python - "$WORKDIR/serve_adaptive_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+assert obj["value"] is not None, obj
+ex = obj.get("extras", {})
+# The acceptance bar (ISSUE 12): the controller strictly beats the best
+# static (prefill_budget, admission_pressure) config on goodput-under-SLO
+# (the arm itself hard-errors if not — adaptive_win_frac > 1 is the
+# recorded witness), with ZERO breach steps, zero retraces through the
+# full knob sweep, and a bit-identical deterministic replay.
+assert ex.get("adaptive_win_frac", 0.0) > 1.0, ex
+assert obj["value"] > ex.get("goodput_static_best", 0.0), ex
+assert ex.get("breach_steps") == 0, ex
+assert ex.get("adaptive_retraces") == 0, ex
+assert ex.get("adaptive_replay_identical") is True, ex
+assert ex.get("controller_actions", 0) > 0, ex
+EOF
+done
+
 echo "perf_gate_smoke: gating serve_smoke suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_smoke \
   --tolerance "$TOL" --report "$WORKDIR/serve_report.md"
@@ -187,5 +222,9 @@ python tools/perf_gate.py --db "$DB" --suite serve_prefix \
 echo "perf_gate_smoke: gating serve_slo suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_slo \
   --tolerance "$TOL" --report "$WORKDIR/serve_slo_report.md"
+
+echo "perf_gate_smoke: gating serve_adaptive suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_adaptive \
+  --tolerance "$TOL" --report "$WORKDIR/serve_adaptive_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

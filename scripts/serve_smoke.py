@@ -169,6 +169,146 @@ def main_fleet(duration_s: float = 30.0, *, rate_hz: float = 4.0,
     return m
 
 
+def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
+                  tail: int = 8, perfdb_path: str | None = None,
+                  stats_jsonl: str | None = None) -> dict:
+    """The ``--adaptive`` arm: a closed-loop warmup, then an overload
+    burst, then a light tail — with the SLO engine and the adaptive
+    ``Controller`` both attached. Asserts the full control story on one
+    run: the burst drives the TTFT objective to WARN, the controller
+    actuates under pressure (level >= 1 moves in its action log), the
+    drain walks the objective back to OK, BREACH never fires, and both
+    compiled steps still traced exactly once. The TTFT threshold is
+    self-calibrated from the warmup's own median (6x), so the arm passes
+    on any machine speed — overload is structural (queue wait across
+    many waves), not a wall-clock constant. Raises RuntimeError on any
+    violation."""
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.slo import BREACH, WARN, Objective
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny", max_length=128)
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    be = BatchEngine(engine, n_slots=4, n_blocks=96, block_size=4,
+                     prefill_chunk=8)
+    rng = np.random.default_rng(seed)
+    start = time.monotonic()
+
+    def one_request(gen: int = 8):
+        prompt = rng.integers(0, config.vocab_size,
+                              size=int(rng.integers(6, 12))).tolist()
+        be.submit(prompt, max_new_tokens=gen)
+
+    # Phase 1 — closed-loop warmup: establishes the healthy-TTFT baseline
+    # (every sample lands in the slow window as a GOOD observation, which
+    # is what structurally caps the slow burn rate below the breach line).
+    for _ in range(warmup):
+        one_request()
+        be.run()
+    base = be.metrics.window("ttft_s", 600.0).get("p50", 0.0)
+    if not base:
+        raise RuntimeError("warmup recorded no TTFT samples")
+    threshold = max(6.0 * base, 0.02)
+
+    # TTFT objective only, q50/burn 1.6: the fast window trips when >=80%
+    # of its samples violate (mid-burst: all of them), while the slow
+    # window holds the warmup's good samples too, so its fraction stays
+    # below 0.8 by construction (burst/(burst+warmup) < 0.8) — WARN yes,
+    # BREACH never, on any machine.
+    slo_engine = be.attach_slo(
+        [Objective.latency("ttft_q50", "ttft_s", threshold, quantile=0.5,
+                           burn=1.6, fast_window_s=2.0,
+                           slow_window_s=600.0, min_count=8)],
+        eval_interval_s=0.1)
+    ctl = be.attach_controller(interval_steps=1, relax_after=6)
+    if stats_jsonl:
+        be.stream_stats(stats_jsonl, interval_s=0.5)
+
+    # Phase 2 — overload: one instantaneous burst, many waves deep. Late
+    # waves queue behind ~burst/n_slots generations, so their TTFT is
+    # hundreds of step times >> 6x the ~3-step warmup baseline. The
+    # pre-burst quiesce ages the warmup's good samples out of the fast
+    # window, and the paced drain keeps the overload IN the fast window
+    # long enough that WARN fires while decode rows are still active —
+    # which is when the controller's level>=1 tighten path can actually
+    # bite (an idle plant has nothing to actuate on).
+    time.sleep(2.2)
+    for _ in range(burst):
+        one_request(gen=48)
+    while be.step():
+        time.sleep(0.005)
+
+    # Phase 3 — light tail, then idle past the fast window so the SLO
+    # walks back to OK (idle steps still evaluate — _obs_tick runs even
+    # when no slot is active).
+    for _ in range(tail):
+        one_request()
+        be.run()
+    settle_until = time.monotonic() + 2.6
+    while time.monotonic() < settle_until:
+        be.step()
+        time.sleep(0.02)
+
+    m = be.metrics.as_dict()
+    submitted = warmup + burst + tail
+    completed = int(m.get("requests_completed", 0))
+    failed = int(m.get("requests_failed", 0))
+    be.pool.check_invariants()
+    if completed != submitted or failed:
+        raise RuntimeError(f"adaptive run: {completed} ok + {failed} "
+                           f"failed != {submitted} submitted")
+    for kind, n in be.trace_counts.items():
+        if n > 1:
+            raise RuntimeError(
+                f"{kind} step retraced {n} times under the control sweep "
+                "— knob moves must be data, not shape")
+    warned = [t for t in slo_engine.transitions if t["new"] == WARN]
+    if not warned:
+        raise RuntimeError("overload burst never drove the SLO to WARN")
+    if slo_engine.n_breaches or any(t["new"] == BREACH
+                                    for t in slo_engine.transitions):
+        raise RuntimeError("adaptive run BREACHed — degradation was not "
+                           "graceful")
+    if slo_engine.worst_level() != 0:
+        raise RuntimeError(f"SLO did not recover to OK: "
+                           f"{slo_engine.verdicts()}")
+    if not ctl.action_log:
+        raise RuntimeError("controller took no actions under overload")
+    pressured = [a for a in ctl.action_log if a.get("level", 0) >= 1]
+    if not pressured:
+        raise RuntimeError("controller never actuated at WARN — the SLO "
+                           "signal did not reach the knobs")
+
+    result = {
+        "requests_submitted": submitted,
+        "requests_completed": completed,
+        "wall_s": round(time.monotonic() - start, 3),
+        "ttft_threshold_s": round(threshold, 5),
+        "warn_transitions": len(warned),
+        "slo_breaches": 0,
+        "slo_verdicts": slo_engine.verdicts(),
+        "controller": ctl.stats(),
+        "pressured_actions": len(pressured),
+        "trace_count_decode": be.trace_counts["decode"],
+        "trace_count_prefill": be.trace_counts["prefill"],
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = be.perfdb_sample()
+        sample["warn_transitions"] = float(len(warned))
+        sample["breach_steps"] = 0.0
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_adaptive", metrics=sample,
+            meta={"seed": seed, "warmup": warmup, "burst": burst})
+        result["perfdb_run_id"] = rec.run_id
+    return result
+
+
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
@@ -346,12 +486,23 @@ if __name__ == "__main__":
     ap.add_argument("--slo", action="store_true",
                     help="attach the stock serving SLO set and report its "
                          "verdicts")
+    ap.add_argument("--adaptive", action="store_true",
+                    help="run the adaptive-control arm: overload burst "
+                         "drives WARN, the controller actuates, recovery "
+                         "walks back to OK with zero BREACH")
     ap.add_argument("--stats-jsonl", default=None,
                     help="stream live stats_snapshot() JSON lines here "
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.replicas > 1:
+        if args.adaptive:
+            if args.chaos or args.replicas > 1:
+                raise SystemExit("--adaptive is its own arm; run it "
+                                 "without --chaos/--replicas")
+            metrics = main_adaptive(seed=args.seed,
+                                    perfdb_path=args.perfdb,
+                                    stats_jsonl=args.stats_jsonl)
+        elif args.replicas > 1:
             if args.slo:
                 # SLO objectives attach per-replica (the fleet health
                 # machine reads them when present) — not a fleet flag yet.

@@ -101,6 +101,38 @@ def test_serve_smoke_fleet_chaos(tmp_path):
     assert "fleet" in frame and "routable" in frame
 
 
+def test_serve_smoke_adaptive(tmp_path):
+    """The --adaptive contract (ISSUE 12): the overload burst drives the
+    self-calibrated TTFT objective to WARN, the attached Controller
+    actuates under pressure (level >= 1 moves), recovery walks the SLO
+    back to OK with ZERO breaches, and the knob sweep never retraces
+    either compiled step (main_adaptive raises on any violation — this
+    test runs that contract under tier 1)."""
+    feed = tmp_path / "adaptive_stats.jsonl"
+    m = _load().main_adaptive(seed=0, stats_jsonl=str(feed))
+    assert m["requests_completed"] == m["requests_submitted"] > 0
+    assert m["warn_transitions"] >= 1
+    assert m["slo_breaches"] == 0
+    assert m["slo_verdicts"] == {"ttft_q50": "OK"}
+    assert m["pressured_actions"] >= 1
+    assert m["controller"]["actions"] >= m["pressured_actions"]
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+
+    # The stats feed carries the controller block; serve_top renders it
+    # as the ctl pane.
+    import json
+
+    from tools import serve_top
+
+    lines = feed.read_text().strip().splitlines()
+    assert lines, "adaptive stats stream wrote nothing"
+    snap = json.loads(lines[-1])
+    assert "controller" in snap and "knobs" in snap["controller"]
+    frame = serve_top.render(snap)
+    assert "ctl" in frame and "knobs" in frame
+
+
 def test_serve_smoke_chaos():
     """The --chaos mode's graceful-degradation contract: the engine rides
     out injected transient errors and NaN-poisoned rows, finishing with

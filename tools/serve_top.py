@@ -60,7 +60,7 @@ def _fleet_lines(fleet: dict) -> list[str]:
         f"  quarantines={fleet.get('quarantines', 0)}"
         f"  backpressure={fleet.get('backpressure', 0)}",
         "    rep  state        slo   queue  active  hit%   requeued  "
-        "tok      done/fail",
+        "reviv  tok      done/fail",
     ]
     for r in fleet.get("replicas", ()):
         state = r.get("state", "?")
@@ -72,10 +72,44 @@ def _fleet_lines(fleet: dict) -> list[str]:
             f"{r.get('active', 0):>3}/{r.get('slots', 0):<3} "
             f"{100.0 * r.get('prefix_hit_rate', 0.0):5.1f}  "
             f"{r.get('requeued', 0):>8}  "
+            f"{r.get('revives', 0):>5}  "
             f"{r.get('tokens', 0):<7}  "
             f"{r.get('completed', 0)}/{r.get('failed', 0)}")
         if r.get("reason"):
             lines.append(f"         └─ {str(r['reason'])[:70]}")
+    return lines
+
+
+def _fmt_knob(v) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else f"{f:.2f}"
+
+
+def _controller_lines(ctl: dict) -> list[str]:
+    """The adaptive control plane pane: current knob values, the last
+    action + its reason, and the flap counters. Shown when a
+    ``Controller`` is attached (``stats_snapshot()['controller']``)."""
+    lines = [
+        f"  ctl    actions={ctl.get('actions', 0)}"
+        f" ({ctl.get('actions_per_min', 0.0)}/min)"
+        f"  oscillations={ctl.get('oscillations', 0)}"
+        f"  faults={ctl.get('act_faults', 0)}"
+        f"  evictions={ctl.get('evictions', 0)}"
+        f"  revives={ctl.get('revives', 0)}"
+        f"  ok_streak={ctl.get('ok_streak', 0)}",
+    ]
+    knobs = ctl.get("knobs", {})
+    if knobs:
+        lines.append("    knobs  " + "  ".join(
+            f"{name}={_fmt_knob(v)}" for name, v in sorted(knobs.items())))
+    last = ctl.get("last_action")
+    if last:
+        lines.append(
+            f"    last   {last.get('knob', '?')} "
+            f"{_fmt_knob(last.get('from', 0))}->"
+            f"{_fmt_knob(last.get('to', 0))}  "
+            f"\"{str(last.get('reason', ''))[:48]}\"  "
+            f"(tick {last.get('tick', '?')}, level {last.get('level', 0)})")
     return lines
 
 
@@ -94,6 +128,8 @@ def render(snap: dict) -> str:
         f"queue={snap.get('queue_depth', 0)}")
     if "fleet" in snap:
         lines.extend(_fleet_lines(snap["fleet"]))
+    if "controller" in snap:
+        lines.extend(_controller_lines(snap["controller"]))
     lines.append(
         f"  slots {_bar(active / total)} {active}/{total}    "
         f"pool {_bar(used / n_blocks)} {used}/{n_blocks} used, "
@@ -166,6 +202,20 @@ def _demo_snapshot(i: int) -> dict:
         "slo": {"states": {"ttft_p99": "OK", "tbt_p99":
                            "BREACH" if slow else "OK"},
                 "breaches": 1 if slow else 0},
+        "controller": {
+            "knobs": {"prefill_budget": 8 if slow else 64,
+                      "admission_pressure": 0.3 if slow else 0.0,
+                      "reclaim_headroom": 0.25 if slow else 0.0},
+            "ticks": i, "actions": 2 * (i // 5),
+            "actions_per_min": 4.0 if slow else 1.2,
+            "oscillations": i // 15, "act_faults": 0,
+            "evictions": 3 if slow else 0, "revives": 0,
+            "ok_streak": 0 if slow else phase,
+            "last_action": {
+                "tick": i, "step": i, "knob": "prefill_budget",
+                "from": 64, "to": 8,
+                "reason": "slo pressure: protect decode TBT",
+                "level": 1} if slow else None},
         "blackbox": {"len": 512, "recorded": 600 * i, "dropped":
                      max(0, 600 * i - 512)},
         "trace_dropped_spans": 0,

@@ -236,10 +236,15 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 # "recovery_steps" (bench --chaos-fleet: fleet steps from quarantine to
 # the (N-1)/N goodput target) and "requeue" (requests displaced off a
 # drained replica / budget exhaustions) are both costs of a fault — a
-# faster recovery and fewer displacements win.
+# faster recovery and fewer displacements win. "breach_steps" (the
+# serve_adaptive suite: steps spent at SLO BREACH — "slo_breach" doesn't
+# substring-match it) and "oscillation" (controller knob direction
+# reversals — the anti-flap witness) are likewise pure costs with no
+# latency spelling: fewer is strictly better.
 _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            "warm_over_cold", "slo_breach",
-                           "recovery_steps", "requeue")
+                           "recovery_steps", "requeue", "breach_steps",
+                           "oscillation")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",

@@ -232,6 +232,13 @@ class SLOEngine:
         """Current state per objective (no evaluation side effects)."""
         return dict(self.states)
 
+    def worst_level(self) -> int:
+        """Worst current objective state as its numeric level (0 OK /
+        1 WARN / 2 BREACH) — the single number the adaptive controller
+        and the fleet health machine key their decisions on."""
+        return max((STATE_LEVEL[v] for v in self.states.values()),
+                   default=0)
+
     def summary(self) -> dict:
         """JSON-able bundle for snapshots / bench extras: states, counts,
         and the recent transition log."""

@@ -28,6 +28,11 @@ Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
   router.route         fleet request placement (serving/router.py) —
                        fires before any signal is read, so a faulted
                        placement defers cleanly to the next step
+  controller.act       the adaptive control plane's per-tick actuation
+                       (serving/controller.py) — fires BEFORE any knob is
+                       applied, so a faulted tick takes the do-nothing
+                       fallback: proposed moves are discarded whole and
+                       the plant keeps its previous knob values
   comm.<collective>    every host-level collective wrapper in kernels/
                        (via the ``obs.comm_ledger.timed`` hook)
 
@@ -187,19 +192,26 @@ def default_chaos_plan(seed: int = 0, *, error_p: float = 0.08,
 
 def default_fleet_chaos_plan(seed: int = 0, *, kill_replica: int = 0,
                              kill_after: int = 4, error_p: float = 0.0,
-                             route_error_p: float = 0.0) -> FaultPlan:
+                             route_error_p: float = 0.0,
+                             kill_fires: int | None = None,
+                             controller_error_p: float = 0.0) -> FaultPlan:
     """The stock ROUTER-SCOPE chaos plan (``bench.py --chaos-fleet``,
     ``scripts/serve_smoke.py --replicas N --chaos``): replica
-    ``kill_replica`` wedges PERMANENTLY after its first ``kill_after``
-    fleet steps (p=1.0 from then on — a dead rank, not a flake), so the
-    fleet must quarantine it, drain its requests, and requeue them onto
-    survivors. Optional background noise: ``error_p`` sprinkles transient
-    step faults across EVERY replica (``replica.*``), ``route_error_p``
-    defers placements at the router. Same seed + same call sequence =
-    bit-identical kill schedule (``plan.log`` is the witness)."""
+    ``kill_replica`` wedges after its first ``kill_after`` fleet steps
+    (p=1.0 from then on — a dead rank, not a flake), so the fleet must
+    quarantine it, drain its requests, and requeue them onto survivors.
+    ``kill_fires`` bounds the wedge (a TRANSIENT kill — e.g. a rank that
+    rebooted): the site stops firing after that many errors, which is the
+    scenario the adaptive controller's ``Fleet.revive()`` recovers from.
+    Optional background noise: ``error_p`` sprinkles transient step faults
+    across EVERY replica (``replica.*``), ``route_error_p`` defers
+    placements at the router, ``controller_error_p`` drops whole control
+    ticks at ``controller.act`` (the do-nothing fallback). Same seed +
+    same call sequence = bit-identical kill schedule (``plan.log`` is the
+    witness)."""
     specs = [
         FaultSpec(site=f"replica.{kill_replica}.step", kind="error",
-                  p=1.0, start_after=kill_after),
+                  p=1.0, start_after=kill_after, max_fires=kill_fires),
     ]
     if error_p > 0.0:
         specs.append(FaultSpec(site="replica.*", kind="error", p=error_p,
@@ -207,6 +219,9 @@ def default_fleet_chaos_plan(seed: int = 0, *, kill_replica: int = 0,
     if route_error_p > 0.0:
         specs.append(FaultSpec(site="router.route", kind="error",
                                p=route_error_p, start_after=1))
+    if controller_error_p > 0.0:
+        specs.append(FaultSpec(site="controller.act", kind="error",
+                               p=controller_error_p, start_after=1))
     return FaultPlan(specs, seed=seed)
 
 

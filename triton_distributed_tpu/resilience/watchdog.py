@@ -172,10 +172,15 @@ class Heartbeat:
         self._breached = False
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Records that a monitor thread was ever REQUESTED (survives
+        # stop_monitor): ``Fleet.revive`` restarts the monitor on a revived
+        # replica only when one was in use before the quarantine teardown.
+        self.monitored = bool(monitor)
         if monitor:
             self.start_monitor()
 
     def start_monitor(self) -> None:
+        self.monitored = True
         if self._thread is not None and self._thread.is_alive():
             return
         # Fresh event per start: a stop()/start() cycle must not hand the
@@ -217,6 +222,14 @@ class Heartbeat:
             raise WatchdogTimeout(
                 f"{self.name} heartbeat gap exceeded {self.interval_s}s "
                 f"(snapshot dumped)")
+
+    def reset(self) -> None:
+        """Re-baseline liveness WITHOUT the resume-after-stall check: for
+        a supervisor (``Fleet.revive``) bringing a torn-down loop back.
+        The gap since the dead loop's last beat is expected there, not a
+        wedge — ``beat()`` would raise ``WatchdogTimeout`` on it."""
+        self._last = time.monotonic()
+        self._breached = False
 
     def check(self) -> None:
         """Raise if the loop has already gone stale (for external pollers

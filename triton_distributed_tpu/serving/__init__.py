@@ -11,10 +11,13 @@ two fixed-shape compiled steps. See docs/serving.md for the design note.
   RadixPrefixCache       — content-addressed, ref-counted KV block reuse
   Fleet / Replica        — N replicas + health machine + drain/requeue
   Router / RouteDecision — cache-/SLO-/load-aware request placement
+  Controller / Knob      — SLO-driven adaptive control plane (budget,
+                           backpressure, reclaim, shed, revive)
   Metrics                — counters / gauges / histograms for the above
 """
 
 from triton_distributed_tpu.serving.batch_engine import BatchEngine
+from triton_distributed_tpu.serving.controller import Controller, Knob
 from triton_distributed_tpu.serving.fleet import (
     DEAD,
     DEGRADED,
@@ -35,8 +38,8 @@ from triton_distributed_tpu.serving.prefix_cache import (
 from triton_distributed_tpu.serving.router import RouteDecision, Router
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
 
-__all__ = ["BatchEngine", "DEAD", "DEGRADED", "DRAINING", "Fleet",
-           "HEALTHY", "Histogram", "KVPool", "Metrics", "PagedKVState",
-           "PrefixMatch", "QUARANTINED", "RECOVERED", "ROUTABLE",
-           "RadixPrefixCache", "Replica", "Request", "RouteDecision",
-           "Router", "Scheduler"]
+__all__ = ["BatchEngine", "Controller", "DEAD", "DEGRADED", "DRAINING",
+           "Fleet", "HEALTHY", "Histogram", "KVPool", "Knob", "Metrics",
+           "PagedKVState", "PrefixMatch", "QUARANTINED", "RECOVERED",
+           "ROUTABLE", "RadixPrefixCache", "Replica", "Request",
+           "RouteDecision", "Router", "Scheduler"]

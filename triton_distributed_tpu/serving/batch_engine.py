@@ -167,6 +167,12 @@ class BatchEngine:
                              f"{world} (required in dist/xla modes)")
         self.n_slots = n_slots
         self.prefill_chunk = prefill_chunk
+        # Runtime chunked-prefill token budget: how much of the compiled
+        # ``prefill_chunk`` ids width a mixed step may actually consume per
+        # row. The adaptive controller (serving/controller.py) moves this
+        # as pure per-step data — ``seq_lens`` narrows, the ids shape never
+        # changes, so the compiled mixed step is untouched.
+        self.prefill_budget = prefill_chunk
         max_seq_len = max_seq_len or engine.max_length
         if n_blocks is None:
             n_blocks = n_slots * -(-max_seq_len // block_size)
@@ -192,6 +198,7 @@ class BatchEngine:
         self._slo = None
         self._slo_eval_interval_s = 1.0
         self._slo_next_eval = 0.0
+        self._controller = None
         self._stats_stream = None
         self._stats_interval_s = 1.0
         self._stats_next_emit = 0.0
@@ -323,6 +330,25 @@ class BatchEngine:
     def slo(self) -> SLOEngine | None:
         return self._slo
 
+    def attach_controller(self, controller=None, **kwargs):
+        """Attach the adaptive control plane (serving/controller.py),
+        piggybacked on ``step()`` the way ``attach_slo`` is: every step the
+        controller observes (SLO level, queue, row mix, pool headroom) and
+        moves its knobs — ``prefill_budget``, ``admission_pressure``,
+        cache reclaim — as pure per-step data (zero retraces). Pass a
+        pre-built ``Controller`` or kwargs for one; returns it. Fleet
+        deployments should attach at ``Fleet`` scope instead (one
+        controller per plant)."""
+        from triton_distributed_tpu.serving.controller import Controller
+        if controller is None:
+            controller = Controller(engine=self, **kwargs)
+        self._controller = controller
+        return controller
+
+    @property
+    def controller(self):
+        return self._controller
+
     def _on_slo_transition(self, obj, old: str, new: str, detail: dict):
         self.metrics.inc("slo_transitions",
                          labels={"objective": obj.name, "to": new})
@@ -416,6 +442,8 @@ class BatchEngine:
         if self._slo is not None:
             snap["slo"] = {"states": self._slo.verdicts(),
                            "breaches": self._slo.n_breaches}
+        if self._controller is not None:
+            snap["controller"] = self._controller.stats()
         if self.blackbox is not None:
             snap["blackbox"] = {"len": len(self.blackbox),
                                 "recorded": self.blackbox.n_recorded,
@@ -485,6 +513,8 @@ class BatchEngine:
                 out[k] = float(m[k])
         out["retraces"] = max(0.0, float(self.trace_counts["decode"]
                                          + self.trace_counts["prefill"] - 2))
+        if self._controller is not None:
+            out.update(self._controller.perfdb_sample())
         # Pool fragmentation (KVPool.fragmentation): lets block-size sweeps
         # in the run DB separate allocator shredding from kernel effects.
         frag = self.pool.fragmentation()
@@ -974,6 +1004,8 @@ class BatchEngine:
         # engine starved by a fault is exactly when the SLO must keep
         # evaluating.
         self._obs_tick()
+        if self._controller is not None:
+            self._controller.on_step()
         if not active:
             return False
         run = (self._run_mixed
@@ -1023,6 +1055,8 @@ class BatchEngine:
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
         self.metrics.inc("decode_steps")
+        self.metrics.inc("decode_rows",
+                         sum(s is not None for s in self._slots))
         if self._guarding:
             self._guard_rows(finite)
         for i, s in enumerate(self._slots):
@@ -1037,16 +1071,23 @@ class BatchEngine:
         L = self.prefill_chunk
         ids = np.zeros((self.n_slots, L), np.int32)
         seq_lens = np.zeros((self.n_slots,), np.int32)
+        pre_toks = dec_rows = 0
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
             if s.prefilling:
-                take = min(L, len(s.ctx) - s.offset)
+                # The controller's runtime budget narrows the consumed
+                # chunk without touching the compiled (n_slots, L) width:
+                # ids stays zero-padded, seq_lens carries the smaller take.
+                budget = min(max(int(self.prefill_budget), 1), L)
+                take = min(budget, len(s.ctx) - s.offset)
                 ids[i, :take] = s.ctx[s.offset:s.offset + take]
                 seq_lens[i] = take
+                pre_toks += take
             else:
                 ids[i, 0] = s.last_tok
                 seq_lens[i] = 1
+                dec_rows += 1
         offsets, tables, mask = self._operands()
         st = self.pool.state
         key = self._next_key()   # drawn ONCE — retries replay the same key
@@ -1062,6 +1103,12 @@ class BatchEngine:
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
         self.metrics.inc("prefill_steps")
+        # Per-step work accounting (prompt tokens actually consumed vs
+        # 1-token decode rows riding the mixed step) — what the adaptive
+        # bench's deterministic cost model and serve_top's rate lines read.
+        self.metrics.inc("prefill_tokens", pre_toks)
+        if dec_rows:
+            self.metrics.inc("decode_rows", dec_rows)
         if self._guarding:
             self._guard_rows(finite)
         for i, s in enumerate(self._slots):

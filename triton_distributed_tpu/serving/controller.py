@@ -1,0 +1,482 @@
+"""SLO-driven adaptive control plane: close the sensors -> actuators loop.
+
+PR 10 built the sensors (multi-window burn-rate SLO engine, sliding-window
+percentiles) and PR 11 built the fleet; this module makes them ACT. The
+``Controller`` is a host-side feedback loop piggybacked on
+``BatchEngine.step()`` / ``Fleet.step()`` exactly the way ``attach_slo``
+is — no threads, no wall-clock pacing in the decision path — that maps the
+observed serving state (SLO OK/WARN/BREACH level, queue depth, decode/
+prefill row mix, prefill backlog, pool headroom, dead replicas) to
+actuator moves on knobs that are all PURE DATA into the already-compiled
+steps:
+
+  prefill_budget       tokens of prompt a mixed step may consume per row
+                       (<= ``prefill_chunk``, the compiled ids width — the
+                       budget narrows ``seq_lens``, never a shape)
+  admission_pressure   the backpressure threshold new admissions must
+                       clear (engine- and fleet-level)
+  reclaim_headroom     prefix-cache eviction aggressiveness: a target
+                       free-block fraction the pool is reclaimed toward
+  warn_shed            the router's WARN-state scoring penalty (fleet):
+                       how hard load is shed away from burning replicas
+  revive               ``Fleet.revive()`` a DEAD replica back to HEALTHY
+                       once its cooldown has passed
+
+Because every move lands in step OPERANDS (masks, seq_lens, thresholds,
+scoring weights), adaptation costs zero retraces: ``trace_counts`` stays
+{1,1} per engine through a full control sweep — the tests hard-check it
+with chaos on.
+
+Control discipline (the part that keeps a controller from amplifying a
+fault into an outage):
+
+  deterministic   decisions are a pure function of the observation stream
+                  and the knob state — no RNG, no wall clock. The
+                  ``action_log`` is the replay witness: same seed + same
+                  observations => identical log, bit for bit.
+  rate-limited    each knob moves at most ``step`` per tick and at most
+                  once per ``cooldown`` ticks.
+  hysteretic      tightening (toward the safe end) is immediate; relaxing
+                  (back toward the default) requires ``relax_after``
+                  consecutive OK ticks — WARN flapping cannot make the
+                  knobs flap. Direction reversals are counted per knob
+                  (``oscillations``) and gated lower-better in perfdb.
+  fail-safe       every tick's actuation runs behind the
+                  ``controller.act`` fault site; ANY actuator error
+                  triggers the do-nothing fallback — proposed moves are
+                  discarded, knob state stays coherent with the plant,
+                  and the skip itself is logged (still deterministic
+                  under a seeded ``FaultPlan``).
+
+Attachment mirrors ``attach_slo``: ``BatchEngine.attach_controller()``
+for a single engine, ``Fleet.attach_controller()`` for fleet scope (which
+then owns the per-replica engine knobs too — don't attach both).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.resilience import faults as _faults
+
+# Default knob bounds. The safe ("tighten") direction is toward lo for the
+# prefill budget (smaller chunks protect decode TBT) and toward hi for the
+# others (more backpressure / more shed / more reclaimed headroom).
+DEFAULT_PRESSURE_HI = 0.5
+DEFAULT_WARN_SHED_HI = 4.0
+DEFAULT_RECLAIM_HI = 0.5
+
+
+@dataclasses.dataclass
+class Knob:
+    """One rate-limited actuator: bounded value + move bookkeeping.
+
+    ``tighten_dir`` is the sign of the SAFE move (+1 raise / -1 lower);
+    moves in the other direction are "relaxations" and only pass the
+    hysteresis gate after a clean OK streak. ``step`` caps the move size
+    per tick, ``cooldown`` the move frequency in ticks.
+    """
+
+    name: str
+    value: float
+    lo: float
+    hi: float
+    step: float
+    relax_to: float
+    tighten_dir: int = 1
+    cooldown: int = 1
+    integer: bool = False
+    last_move_tick: int = -(10 ** 9)
+    last_dir: int = 0
+    reversals: int = 0
+
+    def clamp(self, x: float) -> float:
+        x = min(self.hi, max(self.lo, float(x)))
+        return float(int(round(x))) if self.integer else x
+
+
+def default_engine_knobs(prefill_chunk: int, admission_pressure: float
+                         ) -> dict:
+    """The stock knob set for one ``BatchEngine``: budget / pressure /
+    reclaim, bounded around the engine's construction-time values."""
+    chunk = int(prefill_chunk)
+    return {
+        "prefill_budget": Knob(
+            "prefill_budget", value=float(chunk),
+            lo=float(max(1, chunk // 8)), hi=float(chunk),
+            step=float(max(1, chunk // 4)), relax_to=float(chunk),
+            tighten_dir=-1, integer=True),
+        "admission_pressure": Knob(
+            "admission_pressure", value=float(admission_pressure),
+            lo=float(admission_pressure), hi=DEFAULT_PRESSURE_HI,
+            step=0.1, relax_to=float(admission_pressure), tighten_dir=1),
+        "reclaim_headroom": Knob(
+            "reclaim_headroom", value=0.0, lo=0.0, hi=DEFAULT_RECLAIM_HI,
+            step=0.25, relax_to=0.0, tighten_dir=1),
+    }
+
+
+def default_fleet_knobs(prefill_chunk: int, admission_pressure: float,
+                        warn_penalty: float) -> dict:
+    """Fleet scope = the engine knobs (applied uniformly to every
+    replica) plus the router's WARN shed weight."""
+    knobs = default_engine_knobs(prefill_chunk, admission_pressure)
+    knobs["warn_shed"] = Knob(
+        "warn_shed", value=float(warn_penalty), lo=float(warn_penalty),
+        hi=DEFAULT_WARN_SHED_HI, step=0.75, relax_to=float(warn_penalty),
+        tighten_dir=1)
+    return knobs
+
+
+class Controller:
+    """Deterministic step-paced feedback controller over a ``BatchEngine``
+    or a ``Fleet`` (exactly one; both None gives a plant-less controller
+    the tests drive with synthetic observation streams).
+
+    ``interval_steps``  decide/act every N plant steps (1 = every step).
+    ``relax_after``     consecutive OK ticks required before any knob may
+                        relax back toward its default.
+    ``mid_frac``        the balanced-load prefill budget as a fraction of
+                        ``prefill_chunk`` (mixed decode+prefill traffic).
+    """
+
+    def __init__(self, *, engine=None, fleet=None, knobs: dict | None = None,
+                 interval_steps: int = 1, relax_after: int = 3,
+                 mid_frac: float = 0.25):
+        if engine is not None and fleet is not None:
+            raise ValueError("bind a Controller to an engine OR a fleet")
+        self.engine = engine
+        self.fleet = fleet
+        if knobs is None:
+            if fleet is not None:
+                eng0 = fleet.replicas[0].engine
+                knobs = default_fleet_knobs(eng0.prefill_chunk,
+                                            fleet.admission_pressure,
+                                            fleet.router.slo_penalty[1])
+            elif engine is not None:
+                knobs = default_engine_knobs(engine.prefill_chunk,
+                                             engine.admission_pressure)
+            else:
+                knobs = default_engine_knobs(64, 0.0)
+        self.knobs = knobs
+        self.interval_steps = max(1, int(interval_steps))
+        self.relax_after = max(1, int(relax_after))
+        self.mid_frac = float(mid_frac)
+        self.action_log: list[dict] = []
+        self.last_obs: dict | None = None
+        self.n_ticks = 0
+        self.n_actions = 0
+        self.n_act_faults = 0
+        self.n_evictions = 0
+        self.n_revives = 0
+        self._ok_streak = 0
+        self._steps_seen = 0
+        # Wall-clock start is DISPLAY ONLY (serve_top's actions/min); it
+        # never feeds a decision.
+        self._t0 = time.monotonic()
+
+    # -- observation --------------------------------------------------------
+
+    def _engine_obs(self, eng) -> dict:
+        decode = prefill = backlog = 0
+        for s in eng._slots:
+            if s is None:
+                continue
+            if s.prefilling:
+                prefill += 1
+                backlog += len(s.ctx) - s.offset
+            else:
+                decode += 1
+        backlog += eng.scheduler.backlog_tokens()
+        return {"queue": len(eng.scheduler), "decode_rows": decode,
+                "prefill_rows": prefill, "backlog_tokens": backlog,
+                "free_frac": eng.pool.headroom_frac,
+                "level": (eng.slo.worst_level()
+                          if eng.slo is not None else 0)}
+
+    def observe(self) -> dict:
+        """The deterministic observation bundle ``decide`` consumes —
+        derived purely from plant state (no clocks)."""
+        if self.engine is not None:
+            obs = self._engine_obs(self.engine)
+            obs["step"] = self._steps_seen
+            obs["dead"] = ()
+            return obs
+        if self.fleet is not None:
+            agg = {"queue": len(self.fleet._pending), "decode_rows": 0,
+                   "prefill_rows": 0, "backlog_tokens": 0, "level": 0,
+                   "free": 0, "blocks": 0}
+            from triton_distributed_tpu.serving.fleet import DEAD, ROUTABLE
+            dead = []
+            for rep in self.fleet.replicas:
+                if rep.state == DEAD:
+                    dead.append(rep.idx)
+                if rep.state not in ROUTABLE:
+                    continue
+                o = self._engine_obs(rep.engine)
+                for k in ("queue", "decode_rows", "prefill_rows",
+                          "backlog_tokens"):
+                    agg[k] += o[k]
+                agg["level"] = max(agg["level"], rep.slo_level())
+                pool = rep.engine.pool
+                agg["free"] += pool.n_free + pool.n_reclaimable
+                agg["blocks"] += pool.n_blocks
+            agg["free_frac"] = (agg["free"] / agg["blocks"]
+                                if agg["blocks"] else 1.0)
+            agg.pop("free"), agg.pop("blocks")
+            agg["step"] = self.fleet.n_steps
+            agg["dead"] = tuple(dead)
+            return agg
+        raise ValueError("plant-less controller: feed tick(obs) directly")
+
+    # -- decision -----------------------------------------------------------
+
+    def _propose(self, knob: Knob, target: float, reason: str) -> dict | None:
+        """One rate-limited, hysteresis-gated move toward ``target``.
+        Returns the proposal (knob state NOT yet committed) or None."""
+        target = knob.clamp(target)
+        delta = target - knob.value
+        if delta == 0.0:
+            return None
+        dirn = 1 if delta > 0 else -1
+        if dirn != knob.tighten_dir and self._ok_streak < self.relax_after:
+            return None          # relaxing needs a clean streak
+        if self.n_ticks - knob.last_move_tick < knob.cooldown:
+            return None          # per-knob rate limit
+        new = knob.clamp(knob.value + dirn * min(abs(delta), knob.step))
+        if new == knob.value:
+            return None
+        return {"knob": knob.name, "from": knob.value, "to": new,
+                "dir": dirn, "reason": reason}
+
+    def decide(self, obs: dict) -> list[dict]:
+        """Map one observation to a list of proposed moves. Pure control
+        law over (obs, knob state, ok-streak) — the determinism the replay
+        tests assert lives here."""
+        if obs["level"] == 0:
+            self._ok_streak += 1
+        else:
+            self._ok_streak = 0
+        moves = []
+        b = self.knobs["prefill_budget"]
+        if obs["decode_rows"] == 0 and (obs["prefill_rows"]
+                                        or obs["backlog_tokens"]):
+            mv = self._propose(b, b.hi,
+                               "pure prefill: open the chunk budget")
+            # Widening the budget with nobody decoding cannot hurt TBT:
+            # exempt it from the OK-streak gate (still rate-limited).
+            if mv is None and b.value < b.hi \
+                    and self.n_ticks - b.last_move_tick >= b.cooldown:
+                new = b.clamp(b.value + b.step)
+                mv = {"knob": b.name, "from": b.value, "to": new, "dir": 1,
+                      "reason": "pure prefill: open the chunk budget"}
+            if mv:
+                moves.append(mv)
+        elif obs["level"] >= 1 and obs["decode_rows"] > 0:
+            mv = self._propose(b, b.lo, "slo pressure: protect decode TBT")
+            if mv:
+                moves.append(mv)
+        elif obs["decode_rows"] > 0 and obs["backlog_tokens"] > 0:
+            mid = max(b.lo, round(b.hi * self.mid_frac))
+            mv = self._propose(b, mid, "mixed load: balanced chunk budget")
+            if mv:
+                moves.append(mv)
+        else:
+            mv = self._propose(b, b.relax_to, "healthy: relax budget")
+            if mv:
+                moves.append(mv)
+
+        p = self.knobs["admission_pressure"]
+        if obs["level"] >= 1:
+            mv = self._propose(p, p.hi, "slo pressure: admission "
+                                        "backpressure")
+        elif obs["free_frac"] < 0.15:
+            mv = self._propose(p, p.hi, "pool nearly full: admission "
+                                        "backpressure")
+        else:
+            mv = self._propose(p, p.relax_to, "healthy: relax backpressure")
+        if mv:
+            moves.append(mv)
+
+        r = self.knobs["reclaim_headroom"]
+        if obs["level"] >= 1 or obs["free_frac"] < 0.15:
+            mv = self._propose(r, r.hi, "reclaim cached headroom")
+        else:
+            mv = self._propose(r, r.relax_to, "healthy: stop reclaiming")
+        if mv:
+            moves.append(mv)
+
+        w = self.knobs.get("warn_shed")
+        if w is not None:
+            if obs["level"] >= 1:
+                mv = self._propose(w, w.hi, "slo pressure: shed harder "
+                                            "from burning replicas")
+            else:
+                mv = self._propose(w, w.relax_to, "healthy: relax shed")
+            if mv:
+                moves.append(mv)
+
+        if obs.get("dead"):
+            # At most one revive per tick; Fleet.revive enforces the
+            # death-age cooldown, so a premature proposal is a no-op.
+            moves.append({"knob": "revive", "from": float(obs["dead"][0]),
+                          "to": float(obs["dead"][0]), "dir": 0,
+                          "reason": f"replica {obs['dead'][0]} dead: "
+                                    f"revive"})
+        return moves
+
+    # -- actuation ----------------------------------------------------------
+
+    def _metrics(self):
+        if self.fleet is not None:
+            return self.fleet.metrics
+        if self.engine is not None:
+            return self.engine.metrics
+        return None
+
+    def _set_knob(self, name: str, value: float) -> None:
+        if self.engine is not None:
+            if name == "prefill_budget":
+                self.engine.prefill_budget = int(value)
+            elif name == "admission_pressure":
+                self.engine.admission_pressure = float(value)
+        elif self.fleet is not None:
+            if name == "warn_shed":
+                self.fleet.router.set_slo_penalty(warn=value)
+                return
+            if name == "admission_pressure":
+                self.fleet.admission_pressure = float(value)
+            for rep in self.fleet.replicas:
+                if name == "prefill_budget":
+                    rep.engine.prefill_budget = int(value)
+                elif name == "admission_pressure":
+                    rep.engine.admission_pressure = float(value)
+
+    def _reclaim(self) -> int:
+        """Evict unreferenced cached blocks toward the reclaim-headroom
+        target (the eviction-aggressiveness actuator)."""
+        target = self.knobs["reclaim_headroom"].value
+        if target <= 0.0:
+            return 0
+        freed = 0
+        if self.engine is not None:
+            freed = self.engine.pool.reclaim_to(target)
+        elif self.fleet is not None:
+            for rep in self.fleet.replicas:
+                freed += rep.engine.pool.reclaim_to(target)
+        return freed
+
+    def _actuate(self, mv: dict) -> bool:
+        if mv["knob"] == "revive":
+            return bool(self.fleet is not None
+                        and self.fleet.revive(int(mv["from"])))
+        self._set_knob(mv["knob"], mv["to"])
+        return True
+
+    def _commit(self, mv: dict) -> None:
+        knob = self.knobs.get(mv["knob"])
+        if knob is None:
+            return
+        if knob.last_dir and mv["dir"] != knob.last_dir:
+            knob.reversals += 1
+        knob.last_dir = mv["dir"]
+        knob.last_move_tick = self.n_ticks
+        knob.value = mv["to"]
+
+    def _log(self, mv: dict, obs: dict) -> None:
+        self.action_log.append({
+            "tick": self.n_ticks, "step": obs.get("step", 0),
+            "knob": mv["knob"], "from": mv["from"], "to": mv["to"],
+            "reason": mv["reason"], "level": obs["level"]})
+
+    def tick(self, obs: dict) -> list[dict]:
+        """One control iteration over an explicit observation: decide,
+        fire the ``controller.act`` fault site, actuate, commit, log. Any
+        actuator error takes the do-nothing fallback — no knob moves, no
+        plant mutation survives, the skip is logged."""
+        self.n_ticks += 1
+        self.last_obs = obs
+        moves = self.decide(obs)
+        if not moves:
+            return []
+        m = self._metrics()
+        try:
+            if _faults._PLAN is not None:
+                _faults.fire("controller.act")
+            applied = []
+            for mv in moves:
+                if self._actuate(mv):
+                    applied.append(mv)
+        except Exception as e:  # noqa: BLE001 — actuator error boundary
+            self.n_act_faults += 1
+            if m is not None:
+                m.inc("controller_act_faults")
+            _trace.instant("controller_fault", error=str(e),
+                           skipped=len(moves))
+            self.action_log.append({
+                "tick": self.n_ticks, "step": obs.get("step", 0),
+                "knob": "__fault__", "from": float(len(moves)), "to": 0.0,
+                "reason": f"controller.act fault: skipped "
+                          f"{len(moves)} move(s)", "level": obs["level"]})
+            return []
+        for mv in applied:
+            if mv["knob"] == "revive":
+                self.n_revives += 1
+            self._commit(mv)
+            self._log(mv, obs)
+            self.n_actions += 1
+            if m is not None:
+                m.inc("controller_actions")
+            _trace.instant("controller_action", knob=mv["knob"],
+                           to=mv["to"], reason=mv["reason"])
+        freed = self._reclaim()
+        if freed:
+            self.n_evictions += freed
+            if m is not None:
+                m.inc("controller_evictions", freed)
+        return applied
+
+    def on_step(self) -> None:
+        """The per-plant-step hook (piggybacked like ``_obs_tick``): ticks
+        every ``interval_steps`` steps."""
+        self._steps_seen += 1
+        if self._steps_seen % self.interval_steps:
+            return
+        self.tick(self.observe())
+
+    # -- views --------------------------------------------------------------
+
+    @property
+    def oscillations(self) -> int:
+        """Total direction reversals across all knobs — the perfdb-gated
+        (lower-better) anti-flap number."""
+        return sum(k.reversals for k in self.knobs.values())
+
+    def knob_values(self) -> dict:
+        return {name: k.value for name, k in self.knobs.items()}
+
+    def stats(self) -> dict:
+        """The serve_top controller pane: knob values, last action +
+        reason, actions/min (wall-clock display only), flap counters."""
+        elapsed = max(time.monotonic() - self._t0, 1e-9)
+        last = self.action_log[-1] if self.action_log else None
+        return {"knobs": self.knob_values(), "ticks": self.n_ticks,
+                "actions": self.n_actions,
+                "actions_per_min": round(self.n_actions / elapsed * 60, 2),
+                "oscillations": self.oscillations,
+                "act_faults": self.n_act_faults,
+                "evictions": self.n_evictions,
+                "revives": self.n_revives,
+                "ok_streak": self._ok_streak,
+                "last_action": last}
+
+    def perfdb_sample(self) -> dict:
+        """Flat controller metrics for the ``serve_adaptive`` perfdb
+        suite (directions: oscillations lower-better via the override
+        list in obs/perfdb.py)."""
+        return {"controller_actions": float(self.n_actions),
+                "controller_oscillations": float(self.oscillations),
+                "controller_act_faults": float(self.n_act_faults),
+                "controller_revives": float(self.n_revives)}

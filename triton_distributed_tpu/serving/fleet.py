@@ -95,6 +95,8 @@ class Replica:
     requeued: int = 0            # requests displaced off this replica
     last_error: str | None = None
     quarantine_reason: str | None = None
+    died_at_step: int | None = None   # fleet step of the DEAD transition
+    revives: int = 0             # times revived from DEAD back to HEALTHY
 
     @property
     def active_slots(self) -> int:
@@ -112,10 +114,7 @@ class Replica:
         """Worst objective state (0 OK / 1 WARN / 2 BREACH); 0 with no SLO
         engine attached."""
         slo = self.engine.slo
-        if slo is None:
-            return 0
-        return max((STATE_LEVEL[v] for v in slo.verdicts().values()),
-                   default=0)
+        return 0 if slo is None else slo.worst_level()
 
     def heartbeat_stale(self) -> bool:
         """Staleness matters only while the replica HAS work: an idle
@@ -146,6 +145,10 @@ class Fleet:
                        RECOVERED (one more clean step -> HEALTHY).
     ``admission_pressure`` fleet-wide routing backpressure threshold
                        (fraction of aggregate routable headroom; 0 = off).
+    ``revive_cooldown_steps`` fleet steps a DEAD replica must stay dead
+                       before ``revive()`` will take it back — a replica
+                       that died to a persistent fault must not flap
+                       DEAD->HEALTHY->DEAD every step.
     """
 
     def __init__(self, engines, *, router: Router | None = None,
@@ -153,7 +156,8 @@ class Fleet:
                  fail_threshold: int = 3,
                  breach_quarantine_evals: int = 3,
                  recovery_steps: int = 8,
-                 admission_pressure: float = 0.0):
+                 admission_pressure: float = 0.0,
+                 revive_cooldown_steps: int = 8):
         engines = list(engines)
         if not engines:
             raise ValueError("a fleet needs at least one replica")
@@ -166,8 +170,10 @@ class Fleet:
         self.breach_quarantine_evals = breach_quarantine_evals
         self.recovery_steps = recovery_steps
         self.admission_pressure = admission_pressure
+        self.revive_cooldown_steps = revive_cooldown_steps
         self.metrics = Metrics(windowed=False)
         self.n_steps = 0
+        self._controller = None
         # Fleet-side request plumbing: requests wait here until the router
         # places them; a drained replica's requests come back here too.
         self._pending: list[Request] = []
@@ -188,7 +194,8 @@ class Fleet:
     def build(cls, engine, *, n_replicas: int = 3, router=None,
               requeue=None, fail_threshold: int = 3,
               breach_quarantine_evals: int = 3, recovery_steps: int = 8,
-              admission_pressure: float = 0.0, **batch_engine_kwargs
+              admission_pressure: float = 0.0,
+              revive_cooldown_steps: int = 8, **batch_engine_kwargs
               ) -> "Fleet":
         """N identically-configured replicas over ONE model ``Engine``
         (shared params — requeue-by-recompute stays bit-exact; each
@@ -204,7 +211,8 @@ class Fleet:
                    fail_threshold=fail_threshold,
                    breach_quarantine_evals=breach_quarantine_evals,
                    recovery_steps=recovery_steps,
-                   admission_pressure=admission_pressure)
+                   admission_pressure=admission_pressure,
+                   revive_cooldown_steps=revive_cooldown_steps)
 
     # -- request intake -----------------------------------------------------
 
@@ -328,6 +336,7 @@ class Fleet:
         moved = False
         for rep in self.replicas:
             if rep.state == DRAINING and rep.empty:
+                rep.died_at_step = self.n_steps
                 self._transition(rep, DEAD, "drained")
         for rep in self.replicas:
             if rep.state != QUARANTINED:
@@ -345,6 +354,75 @@ class Fleet:
             self._transition(rep, DRAINING,
                              f"drained {len(reqs)} request(s)")
         return moved
+
+    # -- revival ------------------------------------------------------------
+
+    def revive(self, idx: int, *, force: bool = False) -> bool:
+        """Bring a DEAD replica back to HEALTHY. DEAD is only reached via
+        DRAINING && empty, so the engine is already drained — revival is a
+        host-side reset, NEVER a rebuild: the replica's two compiled steps
+        are reused untouched (``trace_counts`` stays {1,1} through a
+        kill+revive cycle).
+
+        Cooldown-gated: returns False (no-op) until
+        ``revive_cooldown_steps`` fleet steps have passed since the DEAD
+        transition, unless ``force=True``. The reset: a defensive drain
+        (anything left requeues fleet-side), the prefix cache dropped
+        (stale KV from the dead residency must not be adopted), pool
+        invariants verified, health counters cleared, and the heartbeat
+        re-baselined + its monitor restarted if one was running before the
+        quarantine teardown stopped it."""
+        rep = self.replicas[idx]
+        if rep.state != DEAD:
+            raise ValueError(f"replica {idx} is {rep.state}, not DEAD")
+        age = self.n_steps - (rep.died_at_step or 0)
+        if not force and age < self.revive_cooldown_steps:
+            return False
+        eng = rep.engine
+        reason = f"revive replica {idx}"
+        for req in eng.drain(reason=reason):   # defensive: should be empty
+            rep.requeued += 1
+            self._requeue(req, reason)
+        if eng.prefix_cache is not None:
+            eng.prefix_cache.drop()
+        eng.pool.check_invariants()
+        rep.consecutive_failures = 0
+        rep.breach_streak = 0
+        rep.clean_streak = 0
+        rep.last_error = None
+        rep.quarantine_reason = None
+        hb = eng.heartbeat
+        if hb is not None:
+            hb.reset()               # fresh staleness baseline, no raise
+            if hb.monitored:
+                hb.start_monitor()   # restartable by design; idempotent
+        rep.revives += 1
+        rep.died_at_step = None
+        self.metrics.inc("replica_revives")
+        self._transition(rep, HEALTHY,
+                         f"revived after {age} steps dead "
+                         f"(revive #{rep.revives})")
+        return True
+
+    # -- control plane ------------------------------------------------------
+
+    def attach_controller(self, controller=None, **kwargs):
+        """Attach the adaptive control plane at FLEET scope (one
+        controller per plant — do not also attach per-engine ones): every
+        ``step()`` it observes aggregate fleet state and actuates the
+        shared knobs (per-replica ``prefill_budget`` and
+        ``admission_pressure``, fleet backpressure, router WARN shed,
+        cache reclaim) plus cooldown-gated ``revive()`` of DEAD replicas.
+        Returns the controller."""
+        from triton_distributed_tpu.serving.controller import Controller
+        if controller is None:
+            controller = Controller(fleet=self, **kwargs)
+        self._controller = controller
+        return controller
+
+    @property
+    def controller(self):
+        return self._controller
 
     # -- requeue / failure --------------------------------------------------
 
@@ -483,6 +561,8 @@ class Fleet:
         (fleet idle)."""
         self.n_steps += 1
         self._update_health()
+        if self._controller is not None:
+            self._controller.on_step()
         moved = self._drain()
         routed = self._route_pending()
         busy = self._step_replicas()
@@ -595,6 +675,7 @@ class Fleet:
                     m.get("prefix_hits", 0.0) / lookups, 4) if lookups
                     else 0.0,
                 "requeued": rep.requeued,
+                "revives": rep.revives,
                 "tokens": int(m.get("tokens_generated", 0.0)),
                 "completed": len(rep.engine._finished),
                 "failed": len(rep.engine._failed),
@@ -643,9 +724,12 @@ class Fleet:
                 "requeue_exhausted": int(fm.get("requeue_exhausted", 0.0)),
                 "quarantines": int(fm.get("replica_quarantines", 0.0)),
                 "backpressure": int(fm.get("fleet_backpressure", 0.0)),
+                "revives": int(fm.get("replica_revives", 0.0)),
                 "steps": self.n_steps,
                 "replicas": self.replica_table(),
             },
+            **({"controller": self._controller.stats()}
+               if self._controller is not None else {}),
         }
 
     def perfdb_sample(self) -> dict:
@@ -662,9 +746,12 @@ class Fleet:
         out["requests_failed"] = (out.get("requests_failed", 0.0)
                                   + fm.get("requests_failed", 0.0))
         for k in ("requeues", "requeue_exhausted", "replica_quarantines",
-                  "fleet_backpressure", "requests_routed"):
+                  "fleet_backpressure", "requests_routed",
+                  "replica_revives"):
             out[k] = float(fm.get(k, 0.0))
         out["n_replicas"] = float(len(self.replicas))
         out["replicas_dead"] = float(sum(rep.state == DEAD
                                          for rep in self.replicas))
+        if self._controller is not None:
+            out.update(self._controller.perfdb_sample())
         return out

@@ -137,6 +137,28 @@ class KVPool:
         the admission-visible headroom."""
         return sum(1 for r in self._cached.values() if r == 0)
 
+    @property
+    def headroom_frac(self) -> float:
+        """Admission-visible headroom as a fraction of the pool:
+        (free + reclaimable) / total."""
+        return (self.n_free + self.n_reclaimable) / self.n_blocks
+
+    def reclaim_to(self, target_free_frac: float) -> int:
+        """Evict unreferenced cached blocks through the attached prefix
+        cache until ``n_free/n_blocks`` reaches ``target_free_frac`` (or
+        the reclaimable supply runs out). The adaptive controller's
+        eviction-aggressiveness actuator: pure host-side free-list motion,
+        never touches a referenced block. Returns blocks freed."""
+        if self._cache is None:
+            return 0
+        target_free = min(self.n_blocks,
+                          int(float(target_free_frac) * self.n_blocks
+                              + 0.5))
+        need = target_free - self.n_free
+        if need <= 0:
+            return 0
+        return self._cache.evict(min(need, self.n_reclaimable))
+
     def blocks_for(self, n_tokens: int) -> int:
         return blocks_needed(n_tokens, self.block_size)
 

@@ -90,6 +90,20 @@ class Router:
         self._clock = 0
         self.n_routed = 0
 
+    def set_slo_penalty(self, *, warn: float | None = None,
+                        breach: float | None = None) -> tuple:
+        """Runtime shed-weight actuation (the adaptive controller's
+        router knob): replace the WARN and/or BREACH scoring penalties.
+        Pure host-side scoring data — no compiled state anywhere near
+        routing — so the move is free. Returns the new penalty tuple."""
+        ok, w, b = self.slo_penalty
+        w = w if warn is None else float(warn)
+        b = b if breach is None else float(breach)
+        if w < 0 or b < 0:
+            raise ValueError("slo penalties must be >= 0")
+        self.slo_penalty = (ok, w, b)
+        return self.slo_penalty
+
     def score(self, sig: dict) -> float:
         level = min(max(int(sig.get("slo_level", 0)), 0), 2)
         return (self.w_cache * float(sig.get("match_frac", 0.0))

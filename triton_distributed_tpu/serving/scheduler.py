@@ -117,6 +117,12 @@ class Scheduler:
     def pop(self) -> Request:
         return heapq.heappop(self._heap)[2]
 
+    def backlog_tokens(self) -> int:
+        """Total context tokens (prompt + accumulated output) waiting to
+        be prefilled — the queue-side half of the adaptive controller's
+        prefill-backlog observation."""
+        return sum(req.context_len for _, _, req in self._heap)
+
     def admit(self, *, free_slots: int, free_blocks: int,
               block_size: int | None = None, blocks_for=None,
               match_len=None) -> list[Request]:

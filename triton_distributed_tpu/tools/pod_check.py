@@ -200,13 +200,14 @@ def main_fleet(n_replicas: int = 3, deadline_s: float | None = None) -> int:
         return 2
 
     log("  rep  state        slo   queue  active/slots  hit%  requeued  "
-        "done/fail")
+        "revives  done/fail")
     wedged = []
     for row in fleet.replica_table():
         log(f"  {row['idx']:>3}  {row['state']:<11}  {row['slo']:<4}  "
             f"{row['queue']:>5}  {row['active']:>6}/{row['slots']:<5} "
             f"{100.0 * row['prefix_hit_rate']:5.1f}  "
-            f"{row['requeued']:>8}  {row['completed']}/{row['failed']}")
+            f"{row['requeued']:>8}  {row['revives']:>7}  "
+            f"{row['completed']}/{row['failed']}")
         if row["state"] not in ROUTABLE:
             wedged.append((row["idx"], row["state"], row.get("reason")))
     failed = fleet.failed
