@@ -789,6 +789,127 @@ def _bench_serve_slo() -> dict:
     }
 
 
+def _bench_serve_journey() -> dict:
+    """The ``--serve --journey`` arm: cost and sanity of always-on
+    request-journey tracing (obs/journey.py) vs the same engine with the
+    recorder disabled — the same two-engine interleaved-rounds protocol
+    as ``_bench_serve_slo``, so drift cancels:
+
+        journey_overhead_frac = (t_on - t_off) / t_off
+
+    gated at ≤5% on real hardware, recorded-not-gated off-TPU. Asserted
+    everywhere: greedy output bit-identical, zero retraces (journeys are
+    pure host data; ``trace_counts`` stays {1,1}), every finished
+    journey's attribution fractions sum to 1 ± 1e-6, and the exported
+    ``trace.p*.journey.json`` merges into a Chrome trace whose rows carry
+    the dedicated ``journeys`` process."""
+    import tempfile
+    import time as _time
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.journey import BUCKETS
+    from triton_distributed_tpu.obs.trace import merge_chrome_traces
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    kw = dict(n_slots=4, n_blocks=48, block_size=16, prefill_chunk=32)
+    be_on = BatchEngine(engine, **kw)          # journey on (the default)
+    be_off = BatchEngine(engine, **kw, journey=False)
+
+    rng = np.random.default_rng(0)
+    n_req, gen = 16, 8
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(24, 49))).tolist()
+               for _ in range(n_req)]
+
+    def run_pass(be, tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        t0 = _time.perf_counter()
+        done = be.run(max_steps=5000)
+        dt = _time.perf_counter() - t0
+        return [done[r] for r in rids], dt
+
+    out_on, _ = run_pass(be_on, "warm-on")     # compiles off the clock
+    out_off, _ = run_pass(be_off, "warm-off")
+    if out_on != out_off:
+        raise RuntimeError("journey recording changed greedy output")
+
+    rounds = 6 if on_tpu else 3
+    t_on, t_off = [], []
+    for r in range(rounds):                    # interleaved: drift cancels
+        _, dt = run_pass(be_off, f"r{r}-off")
+        t_off.append(dt)
+        _, dt = run_pass(be_on, f"r{r}-on")
+        t_on.append(dt)
+    s_off, s_on = min(t_off), min(t_on)
+    frac = (s_on - s_off) / s_off
+
+    for be, tag in ((be_on, "on"), (be_off, "off")):
+        retr = be.trace_counts["decode"] + be.trace_counts["prefill"] - 2
+        if retr:
+            raise RuntimeError(f"journey-{tag} engine retraced {retr}x")
+        be.pool.check_invariants()
+
+    rec = be_on.journey
+    bad = [s for s in rec.summaries
+           if s["total_s"] > 0.0
+           and abs(sum(s["fracs"][b] for b in BUCKETS) - 1.0) > 1e-6]
+    if bad:
+        raise RuntimeError(
+            f"{len(bad)} journeys broke the fractions-sum-to-1 contract "
+            f"(first: {bad[0]['req']})")
+    with tempfile.TemporaryDirectory() as td:
+        rec.export_chrome_trace(td)
+        with open(merge_chrome_traces(td)) as f:
+            merged = json.load(f)
+        n_journey_rows = sum(
+            1 for e in merged["traceEvents"]
+            if e.get("cat") == "journey" and e.get("ph") == "X")
+        if not n_journey_rows:
+            raise RuntimeError("merged Chrome trace carries no journey "
+                               "phase rows")
+    snap = be_on.stats_snapshot()              # exercised, must be JSON-able
+    json.dumps(snap, default=str)
+    ok = (frac <= 0.05) or not on_tpu
+    extras = {
+        "serve_journey_off_s": round(s_off, 6),
+        "serve_journey_on_s": round(s_on, 6),
+        "journey_overhead_ok": ok,
+        "journey_overhead_gated": on_tpu,
+        "serve_journey_bit_identical": True,
+        "serve_journey_retraces": 0,
+        "journey_finished": int(rec.n_finished),
+        "journey_kept": int(len(rec.kept)),
+        "journey_event_drops": int(rec.n_event_drops),
+        "journey_frac_sum_ok": True,
+        "journey_chrome_rows": int(n_journey_rows),
+    }
+    if not ok:
+        raise RuntimeError(
+            f"journey recording overhead {frac:.1%} exceeds the 5% "
+            f"step-time budget (off={s_off:.4f}s on={s_on:.4f}s)")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "journey_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 # --- adaptive-control arm (--serve --adaptive) -----------------------------
 #
 # Deterministic virtual-time cost model: one BatchEngine step costs a fixed
@@ -1078,19 +1199,24 @@ def main():
     # process against each other).
     if "--serve" in sys.argv:
         # --serve --slo: always-on telemetry overhead arm; --serve
+        # --journey: request-journey tracing overhead arm; --serve
         # --adaptive: the SLO-driven controller vs the static grid (all
         # deterministic virtual time, so CPU CI gates it); plain --serve:
-        # the prefix-cache arm. Same placement rationale for all three.
+        # the prefix-cache arm. Same placement rationale for all four.
         with_slo = "--slo" in sys.argv
         adaptive = "--adaptive" in sys.argv
+        with_journey = "--journey" in sys.argv
         metric = ("goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
+                  else "journey_overhead_frac" if with_journey
                   else "prefix_hit_rate")
         try:
             if adaptive:
                 result = _bench_serve_adaptive()
             elif with_slo:
                 result = _bench_serve_slo()
+            elif with_journey:
+                result = _bench_serve_journey()
             else:
                 result = _bench_serve_prefix()
         except Exception as e:  # noqa: BLE001
@@ -1105,6 +1231,7 @@ def main():
         _record_perfdb(result, perfdb_path,
                        suite=("serve_adaptive" if adaptive
                               else "serve_slo" if with_slo
+                              else "serve_journey" if with_journey
                               else "serve_prefix"))
         return
 

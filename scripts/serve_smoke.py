@@ -282,6 +282,14 @@ def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
     if not pressured:
         raise RuntimeError("controller never actuated at WARN — the SLO "
                            "signal did not reach the knobs")
+    # Journey attribution must SEE the overload: the burst queues many
+    # waves deep, so the mean queue-wait fraction across finished
+    # journeys is structurally nonzero (machine-speed independent).
+    journey_fracs = be.journey.mean_fracs()
+    if not journey_fracs["queue"] > 0.0:
+        raise RuntimeError("overload burst left zero journey queue-wait "
+                           "attribution — the journey phase machine "
+                           "missed the queue phase")
 
     result = {
         "requests_submitted": submitted,
@@ -295,6 +303,8 @@ def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
         "pressured_actions": len(pressured),
         "trace_count_decode": be.trace_counts["decode"],
         "trace_count_prefill": be.trace_counts["prefill"],
+        "journey_mean_fracs": journey_fracs,
+        "journey_slowest": be.journey.slowest(4),
     }
     if perfdb_path:
         from triton_distributed_tpu.obs.perfdb import PerfDB

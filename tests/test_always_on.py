@@ -197,6 +197,46 @@ def test_blackbox_rejects_zero_capacity():
         Blackbox(capacity=0)
 
 
+def test_blackbox_seq_survives_wraparound_and_dump_json(tmp_path):
+    """The ``seq`` satellite (ISSUE 13): a CONSTANT clock puts every
+    event on the same tick, so after the ring wraps only the monotonic
+    ``seq`` counter keeps a total order — ``events()`` must sort on it,
+    and ``dump_json`` must round-trip the whole bundle byte-exactly."""
+    bb = Blackbox(capacity=4, clock=FakeClock(5.0))
+    for i in range(11):
+        bb.record("ev", i=i)
+    assert bb.n_recorded == 11 and bb.n_dropped == 7
+    evs = bb.events()
+    assert [e["i"] for e in evs] == [7, 8, 9, 10]
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]
+    assert all(e["t"] == 5.0 for e in evs)      # clock alone can't order
+
+    path = bb.dump_json(str(tmp_path / "sub" / "bb.json"))
+    with open(path, encoding="utf-8") as f:
+        loaded = json.load(f)
+    assert loaded["capacity"] == 4
+    assert loaded["recorded"] == 11 and loaded["dropped"] == 7
+    assert loaded["events"] == evs              # JSON-able as-is
+
+
+def test_tail_sampler_keep_drop_determinism_under_fixed_seed():
+    """The keep/drop verdict SEQUENCE is a pure function of (seed, submit
+    order): two same-seed samplers agree on every one of 200 verdicts; a
+    different seed picks a different head sample."""
+    def verdicts(seed):
+        s = TailSampler(head_frac=0.3, slow_s=None, seed=seed)
+        out = []
+        for i in range(200):
+            s.begin(f"r{i}")
+            out.append(s.finish(f"r{i}", latency_s=1e-4))
+        return out
+
+    a = verdicts(3)
+    assert a == verdicts(3)
+    assert any(a) and not all(a)                # a real 0<frac<1 sample
+    assert a != verdicts(4)
+
+
 # ---------------------------------------------------------------------------
 # tail sampler
 # ---------------------------------------------------------------------------

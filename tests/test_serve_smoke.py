@@ -118,6 +118,11 @@ def test_serve_smoke_adaptive(tmp_path):
     assert m["controller"]["actions"] >= m["pressured_actions"]
     assert m["trace_count_decode"] == 1
     assert m["trace_count_prefill"] == 1
+    # Journey attribution sees the overload (ISSUE 13): the burst queues
+    # many waves deep, so the mean queue-wait fraction is nonzero and
+    # every bucket mean stays a valid fraction.
+    assert m["journey_mean_fracs"]["queue"] > 0.0
+    assert all(0.0 <= v <= 1.0 for v in m["journey_mean_fracs"].values())
 
     # The stats feed carries the controller block; serve_top renders it
     # as the ctl pane.
@@ -129,8 +134,11 @@ def test_serve_smoke_adaptive(tmp_path):
     assert lines, "adaptive stats stream wrote nothing"
     snap = json.loads(lines[-1])
     assert "controller" in snap and "knobs" in snap["controller"]
+    # ... and the journey block, rendered as the slowest-journeys pane.
+    assert "journey" in snap and "mean_fracs" in snap["journey"]
     frame = serve_top.render(snap)
     assert "ctl" in frame and "knobs" in frame
+    assert "journeys" in frame
 
 
 def test_serve_smoke_chaos():

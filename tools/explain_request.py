@@ -1,0 +1,384 @@
+#!/usr/bin/env python
+"""explain_request: forensic markdown report for ONE request's journey.
+
+Reconstructs a single request's fleet-wide causal timeline post-hoc from
+journey data (``obs/journey.py``) and renders it as markdown: the hop
+chain (submit -> route -> drain -> requeue -> ...), every route decision
+with the per-candidate score breakdown (why the winner beat the
+runner-up), the controller/SLO/fault global events that fired while the
+request was in flight, and the critical-path latency attribution — per-
+bucket seconds and fractions that must sum to 1.0 +/- 1e-6 (checked; a
+violation is exit 1, not a warning).
+
+    # post-hoc, from a JourneyRecorder.dump_json file
+    python tools/explain_request.py --journal dump.json --req req-3
+    python tools/explain_request.py --journal dump.json --slowest
+
+    # self-contained deterministic demo: tiny fleet + seeded chaos kill,
+    # virtual step clock -> byte-identical report per seed
+    python tools/explain_request.py --chaos --seed 0
+
+The ``--chaos`` mode builds a 2-replica tiny-model fleet, installs
+``default_fleet_chaos_plan`` (replica 0 wedges mid-run -> quarantine ->
+drain -> requeue onto the survivor), swaps the shared recorder's clock
+for a virtual per-call step counter so every timestamp is reproducible,
+then reconstructs a requeued request through the SAME ``Journey.stitch``
+path the ``--journal`` mode uses. Exit 0 clean; 1 when reconstruction
+fails (unknown request, broken fraction sum, or no requeued request in
+the chaos run); 2 on usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/explain_request.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.obs.journey import BUCKETS, Journey  # noqa: E402
+
+_TOL = 1e-6
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _fmt_t(t) -> str:
+    return f"{float(t):.6f}"
+
+
+def _fmt_where(w) -> str:
+    return "-" if w is None else f"replica {w}"
+
+
+def _hop_lines(j: Journey) -> list[str]:
+    lines = ["## Hop chain", "",
+             "| hop | kind | where | t |",
+             "|---:|---|---|---:|"]
+    for h in j.hops:
+        t = _fmt_t(h["t"]) if "t" in h else "-"
+        lines.append(f"| {h['hop']} | {h['kind']} | "
+                     f"{_fmt_where(h.get('where'))} | {t} |")
+    lines.append("")
+    return lines
+
+
+def _route_lines(j: Journey) -> list[str]:
+    """One breakdown table per route decision: every candidate's weighted
+    score components (signs included, summing to its score), winner
+    first, plus the winner-vs-runner-up component margin — the 'why'."""
+    routes = [e for e in j.events if e.get("kind") == "route"]
+    if not routes:
+        return []
+    lines = ["## Route decisions", ""]
+    for ev in routes:
+        scores = {str(k): float(v)
+                  for k, v in (ev.get("scores") or {}).items()}
+        breakdown = ev.get("breakdown") or {}
+        winner = str(ev.get("replica"))
+        lines.append(f"### hop {ev.get('hop', '?')} -> replica {winner} "
+                     f"(score {_fmt_t(ev.get('score', 0.0))})")
+        lines.append("")
+        if scores:
+            comps = ("cache", "headroom", "queue", "slo")
+            lines.append("| replica | " + " | ".join(comps)
+                         + " | score | |")
+            lines.append("|---:|" + "---:|" * (len(comps) + 1) + "---|")
+            order = sorted(scores, key=lambda r: (-scores[r], r))
+            for rid in order:
+                bd = {c: float(v)
+                      for c, v in (breakdown.get(rid) or {}).items()}
+                mark = "**won**" if rid == winner else ""
+                lines.append(
+                    f"| {rid} | "
+                    + " | ".join(_fmt_t(bd.get(c, 0.0)) for c in comps)
+                    + f" | {_fmt_t(scores[rid])} | {mark} |")
+            if len(order) >= 2 and order[0] == winner:
+                ru = order[1]
+                wb = breakdown.get(winner) or {}
+                rb = breakdown.get(ru) or {}
+                deltas = {c: float(wb.get(c, 0.0)) - float(rb.get(c, 0.0))
+                          for c in comps}
+                top = max(deltas, key=lambda c: deltas[c])
+                lines.append("")
+                lines.append(
+                    f"margin over runner-up (replica {ru}): "
+                    f"{_fmt_t(scores[winner] - scores[ru])}"
+                    f" — decided by `{top}` ({_fmt_t(deltas[top])})")
+        lines.append("")
+    return lines
+
+
+def _attribution_lines(j: Journey) -> list[str]:
+    s = j.summary
+    attr, fracs = s["attribution_s"], s["fracs"]
+    lines = ["## Latency attribution", "",
+             "| bucket | seconds | fraction |",
+             "|---|---:|---:|"]
+    for b in BUCKETS:
+        lines.append(f"| {b} | {attr[b]:.9f} | {fracs[b]:.9f} |")
+    fsum = sum(fracs[b] for b in BUCKETS)
+    lines.append(f"| **total** | {s['total_s']:.9f} | {fsum:.9f} |")
+    lines.append("")
+    lines.append(f"fraction sum = {fsum:.9f} "
+                 f"(|sum - 1| = {abs(fsum - 1.0):.2e}, tolerance "
+                 f"{_TOL:.0e})")
+    lines.append("")
+    if s.get("budget_split"):
+        lines.append("### Prefill budget split")
+        lines.append("")
+        lines.append("| prefill_budget | chunks | tokens |")
+        lines.append("|---:|---:|---:|")
+        for budget in sorted(s["budget_split"], key=int):
+            d = s["budget_split"][budget]
+            lines.append(f"| {budget} | {d['chunks']} | {d['tokens']} |")
+        lines.append("")
+    lines.append(f"prefix-cache discount: {s['cached_tokens']} tokens "
+                 f"adopted from cache ({s['prefill_tokens']} recomputed) "
+                 "— time *not* spent, outside the fraction sum")
+    lines.append("")
+    return lines
+
+
+def _global_lines(j: Journey) -> list[str]:
+    lines = ["## In-flight global events", ""]
+    if not j.globals_:
+        lines.append("(none: no controller action, SLO transition, or "
+                     "fault firing overlapped this request)")
+        lines.append("")
+        return lines
+    lines.append("| t | kind | detail |")
+    lines.append("|---:|---|---|")
+    for g in j.globals_:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(g.items())
+            if k not in ("t", "seq", "kind"))
+        lines.append(f"| {_fmt_t(g.get('t', 0.0))} | {g.get('kind')} "
+                     f"| {detail} |")
+    lines.append("")
+    return lines
+
+
+def _timeline_lines(j: Journey) -> list[str]:
+    lines = ["## Event timeline", "",
+             "| t | hop | kind | detail |",
+             "|---:|---:|---|---|"]
+    skip = ("t", "seq", "kind", "req", "hop", "scores", "breakdown")
+    for ev in j.events:
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(ev.items())
+                           if k not in skip)
+        hop = ev.get("hop", "")
+        lines.append(f"| {_fmt_t(ev.get('t', 0.0))} | {hop} "
+                     f"| {ev.get('kind')} | {detail} |")
+    if j.events_dropped:
+        lines.append("")
+        lines.append(f"({j.events_dropped} events dropped at the "
+                     "per-request cap; attribution is exact — it streams "
+                     "through the accumulator, not the event list)")
+    lines.append("")
+    return lines
+
+
+def render(j: Journey) -> str:
+    """The full markdown report for one stitched journey."""
+    s = j.summary
+    lines = [
+        f"# explain_request: {j.req_id}", "",
+        "| field | value |",
+        "|---|---|",
+        f"| status | {j.status}"
+        + (f" ({j.error})" if j.error else "") + " |",
+        f"| total latency | {s['total_s']:.9f} s |",
+        f"| dominant bucket | {s['dominant']} "
+        f"({s['fracs'][s['dominant']]:.6f}) |",
+        f"| hops | {len(j.hops)} |",
+        f"| admits | {s['n_admits']} | ",
+        f"| requeues | {s['n_requeues']} |",
+        f"| preemptions | {s['n_preempts']} |",
+        "",
+    ]
+    lines += _hop_lines(j)
+    lines += _route_lines(j)
+    lines += _attribution_lines(j)
+    lines += _global_lines(j)
+    lines += _timeline_lines(j)
+    return "\n".join(lines)
+
+
+def check_fractions(j: Journey) -> float:
+    """|sum(fracs) - 1|; raises ValueError past tolerance (exit 1)."""
+    err = abs(sum(j.summary["fracs"][b] for b in BUCKETS) - 1.0)
+    if j.summary["total_s"] > 0.0 and err > _TOL:
+        raise ValueError(
+            f"attribution fractions sum to 1 +/- {err:.3e} for "
+            f"{j.req_id} (tolerance {_TOL:.0e}) — phase state machine "
+            "violated")
+    return err
+
+
+# -- journal mode ------------------------------------------------------------
+
+def _restitch(jd: dict) -> Journey:
+    """Reconstruct a Journey from one ``dump()`` entry through the same
+    ``Journey.stitch`` state machine the live recorder ran — then check
+    the two agree (a dump/stitch divergence is a real bug, exit 1)."""
+    j = Journey.stitch(jd["events"], req_id=jd["req"], hops=jd["hops"],
+                       globals_events=jd.get("globals", ()),
+                       status=jd.get("status"), error=jd.get("error"))
+    j.events_dropped = int(jd.get("events_dropped", 0))
+    live = jd.get("summary", {}).get("fracs")
+    if live:
+        drift = max(abs(j.summary["fracs"][b] - live[b]) for b in BUCKETS)
+        if drift > _TOL:
+            raise ValueError(
+                f"re-stitched attribution diverges from the live summary "
+                f"by {drift:.3e} for {jd['req']} — stitch and recorder "
+                "disagree")
+    return j
+
+
+def explain_from_journal(path: str, *, req_id: str | None,
+                         slowest: bool) -> Journey:
+    with open(path, encoding="utf-8") as f:
+        dump = json.load(f)
+    journeys = dump.get("journeys", [])
+    if not journeys:
+        raise LookupError(f"{path}: no kept journeys in the journal "
+                          "(only O(1) summaries survived the tail "
+                          "sampler)")
+    if slowest:
+        jd = max(journeys,
+                 key=lambda d: (d["summary"]["total_s"], d["req"]))
+    else:
+        matches = [d for d in journeys if d["req"] == str(req_id)]
+        if not matches:
+            have = ", ".join(d["req"] for d in journeys[:8])
+            raise LookupError(
+                f"{path}: request {req_id!r} not among the kept "
+                f"journeys (have: {have}{'...' if len(journeys) > 8 else ''})")
+        jd = matches[0]
+    return _restitch(jd)
+
+
+# -- chaos demo mode ---------------------------------------------------------
+
+class _StepClock:
+    """Virtual clock: each read advances one fixed tick. Journey
+    timestamps become call-ordinals — deterministic for a fixed seed, so
+    the rendered report is byte-identical across runs."""
+
+    def __init__(self, tick: float = 1e-3):
+        self.n = 0
+        self.tick = tick
+
+    def __call__(self) -> float:
+        self.n += 1
+        return self.n * self.tick
+
+
+def run_chaos_demo(seed: int, *, n_requests: int = 8,
+                   dump_path: str | None = None) -> Journey:
+    """Seeded 2-replica fleet with a mid-run replica kill; returns the
+    re-stitched journey of the first requeued request that finished —
+    the route -> kill -> drain -> requeue -> re-route -> finish chain."""
+    import jax                                    # deferred: heavy
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import faults
+    from triton_distributed_tpu.resilience.faults import (
+        default_fleet_chaos_plan,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving.fleet import Fleet
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                     set_default=False)
+    config = ModelConfig.from_name("tiny")
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    fleet = Fleet.build(engine, n_replicas=2, fail_threshold=2,
+                        n_slots=4, n_blocks=24, block_size=4,
+                        prefill_chunk=8, seed=seed)
+    fleet.journey.clock = _StepClock()            # determinism: see class
+    rng = np.random.default_rng(seed)
+    for _ in range(n_requests):
+        n = int(rng.integers(4, 20))
+        prompt = rng.integers(1, config.vocab_size, size=n).tolist()
+        fleet.submit(prompt, 6)
+    plan = default_fleet_chaos_plan(seed, kill_replica=0, kill_after=3)
+    with faults.plan(plan):
+        out = fleet.run(max_steps=500)
+    fleet.check_invariants()
+    if dump_path:
+        fleet.journey.dump_json(dump_path)
+    requeued = sorted(
+        (rid for rid in fleet._requeues if rid in out),
+        key=str)
+    if not requeued:
+        raise LookupError(
+            f"chaos run (seed {seed}) produced no requeued+finished "
+            "request — cannot demonstrate the displacement chain")
+    # Reconstruct through the post-hoc dump -> stitch path (NOT the live
+    # Journey object): the demo exercises exactly what a forensic run
+    # against a dumped journal would do.
+    dump = fleet.journey.dump()
+    jd = next(d for d in dump["journeys"] if d["req"] == str(requeued[0]))
+    return _restitch(jd)
+
+
+# -- entry -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--journal", default=None,
+                    help="JourneyRecorder.dump_json file to read")
+    ap.add_argument("--req", default=None,
+                    help="request id to explain (with --journal)")
+    ap.add_argument("--slowest", action="store_true",
+                    help="explain the slowest kept journey")
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the seeded fleet chaos demo instead of "
+                         "reading a journal")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="demo seed (chaos plan + prompts + clock)")
+    ap.add_argument("--dump-journal", default=None,
+                    help="with --chaos: also write the recorder dump "
+                         "here for later --journal runs")
+    ap.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    if args.chaos == (args.journal is not None):
+        ap.error("pick exactly one mode: --chaos or --journal PATH")
+    if args.journal and args.req is None and not args.slowest:
+        ap.error("--journal needs --req ID or --slowest")
+
+    try:
+        if args.chaos:
+            j = run_chaos_demo(args.seed, dump_path=args.dump_journal)
+        else:
+            j = explain_from_journal(args.journal, req_id=args.req,
+                                     slowest=args.slowest)
+        check_fractions(j)
+    except (OSError, json.JSONDecodeError) as e:
+        sys.stderr.write(f"explain_request: {e}\n")
+        return 2
+    except (LookupError, ValueError) as e:
+        sys.stderr.write(f"explain_request: {e}\n")
+        return 1
+
+    report = render(j) + "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(report)
+        sys.stdout.write(f"wrote {args.out}\n")
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -113,6 +113,37 @@ def _controller_lines(ctl: dict) -> list[str]:
     return lines
 
 
+def _journey_lines(jn: dict) -> list[str]:
+    """The slowest-journeys pane (``stats_snapshot()['journey']``): top-k
+    tail requests by total latency with each one's dominant attribution
+    bucket, plus the fleet-mean attribution split."""
+    lines = [
+        f"  journeys  finished={jn.get('finished', 0)}"
+        f"  in_flight={jn.get('in_flight', 0)}"
+        f"  kept={jn.get('kept', 0)}",
+    ]
+    means = jn.get("mean_fracs", {})
+    if means:
+        lines.append("    mean   " + "  ".join(
+            f"{b}={100.0 * float(means.get(b, 0.0)):.0f}%"
+            for b in ("queue", "route", "prefill", "decode",
+                      "preempted", "requeue")))
+    rows = jn.get("slowest", ())
+    if rows:
+        lines.append("    slowest      req        total_ms  dominant"
+                     "          rq  pre")
+        for r in rows[:6]:
+            mark = "" if r.get("status", "ok") == "ok" else "  *failed*"
+            lines.append(
+                f"      {str(r.get('req', '?')):<14} "
+                f"{1e3 * float(r.get('total_s', 0.0)):>12.1f}  "
+                f"{r.get('dominant', '?'):<8} "
+                f"{100.0 * float(r.get('frac', 0.0)):3.0f}%  "
+                f"{r.get('requeues', 0):>2}  {r.get('preempts', 0):>3}"
+                f"{mark}")
+    return lines
+
+
 def render(snap: dict) -> str:
     """Render one ``BatchEngine.stats_snapshot()`` (or
     ``Fleet.stats_snapshot()``) dict as a text frame."""
@@ -158,6 +189,9 @@ def render(snap: dict) -> str:
             f"{name}={_SLO_MARK.get(st, st)}"
             for name, st in sorted(slo.get("states", {}).items()))
         lines.append(f"  slo  {states}  breaches={slo.get('breaches', 0)}")
+    jn = snap.get("journey")
+    if jn:
+        lines.extend(_journey_lines(jn))
     drops = []
     bb = snap.get("blackbox")
     if bb:
@@ -170,6 +204,9 @@ def render(snap: dict) -> str:
         drops.append(f"sampler {sam.get('retained', 0)} kept "
                      f"({sam.get('kept_tail', 0)} tail) / "
                      f"{sam.get('dropped', 0)} dropped")
+    if jn and (jn.get("event_drops", 0) or jn.get("pending_drops", 0)):
+        drops.append(f"journey {jn.get('event_drops', 0)} ev / "
+                     f"{jn.get('pending_drops', 0)} pending dropped")
     if drops:
         lines.append("  telemetry  " + "   ".join(drops))
     return "\n".join(lines) + "\n"
@@ -216,6 +253,23 @@ def _demo_snapshot(i: int) -> dict:
                 "from": 64, "to": 8,
                 "reason": "slo pressure: protect decode TBT",
                 "level": 1} if slow else None},
+        "journey": {
+            "begun": 10 * i + 4, "finished": 10 * i, "in_flight": 4,
+            "kept": min(10 * i, 32), "event_drops": 0,
+            "pending_drops": 0,
+            "mean_fracs": {"queue": 0.42 if slow else 0.08, "route": 0.01,
+                           "prefill": 0.2, "decode":
+                           0.37 if slow else 0.71, "preempted": 0.0,
+                           "requeue": 0.0},
+            "slowest": [
+                {"req": "req-91", "total_s": 2.4 if slow else 0.61,
+                 "dominant": "queue" if slow else "decode",
+                 "frac": 0.61, "status": "ok", "requeues": 1,
+                 "preempts": 0},
+                {"req": "req-87", "total_s": 0.44, "dominant": "decode",
+                 "frac": 0.8, "status": "ok", "requeues": 0,
+                 "preempts": 1},
+            ]},
         "blackbox": {"len": 512, "recorded": 600 * i, "dropped":
                      max(0, 600 * i - 512)},
         "trace_dropped_spans": 0,

@@ -30,6 +30,12 @@ runs for weeks):
                    BREACH fires the resilience snapshot path.
   obs.blackbox     flight recorder: bounded ring of structured serving
                    lifecycle events, dumped whole into breach snapshots.
+  obs.journey      request-journey tracing: per-request hop ids threaded
+                   router -> replica -> scheduler -> engine, stitched
+                   into one causal timeline with critical-path latency
+                   attribution (queue/route/prefill/decode/preempted/
+                   requeue fractions summing to 1); tail-kept detail,
+                   O(1) summaries for everyone else.
   TailSampler      (obs.trace) per-request trace sampling that always
                    keeps slow/errored requests plus a deterministic
                    head-sampled fraction.
@@ -52,12 +58,18 @@ permanently. Design note: docs/observability.md.
 
 from triton_distributed_tpu.obs import blackbox  # noqa: F401
 from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
+from triton_distributed_tpu.obs import journey  # noqa: F401
 from triton_distributed_tpu.obs import perfdb  # noqa: F401
 from triton_distributed_tpu.obs import roofline  # noqa: F401
 from triton_distributed_tpu.obs import slo  # noqa: F401
 from triton_distributed_tpu.obs import trace  # noqa: F401
 from triton_distributed_tpu.obs import window  # noqa: F401
 from triton_distributed_tpu.obs.blackbox import Blackbox  # noqa: F401
+from triton_distributed_tpu.obs.journey import (  # noqa: F401
+    Journey,
+    JourneyContext,
+    JourneyRecorder,
+)
 from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
     CommLedger,
     LedgerEntry,
@@ -94,10 +106,11 @@ from triton_distributed_tpu.obs.window import (  # noqa: F401
 
 __all__ = [
     "Blackbox", "CommLedger", "FingerprintMismatch", "Histogram",
-    "LedgerEntry", "Metrics", "Objective", "PerfDB", "RequestTrace",
+    "Journey", "JourneyContext", "JourneyRecorder", "LedgerEntry",
+    "Metrics", "Objective", "PerfDB", "RequestTrace",
     "RooflineRecord", "RunRecord", "SLOEngine", "SpanRecord",
     "TailSampler", "Tracer", "Verdict", "WindowRing", "WindowStats",
     "blackbox", "comm_ledger", "default_serving_slo", "group_profile",
-    "merge_chrome_traces", "parse_prometheus", "perfdb", "roofline",
-    "slo", "trace", "window",
+    "journey", "merge_chrome_traces", "parse_prometheus", "perfdb",
+    "roofline", "slo", "trace", "window",
 ]

@@ -42,20 +42,26 @@ class Blackbox:
         return len(self._ring)
 
     def record(self, kind: str, **fields) -> None:
-        """Append one event; evicts (and counts) the oldest when full."""
+        """Append one event; evicts (and counts) the oldest when full.
+        ``seq`` is a per-recorder monotonic counter: two events in the
+        same clock tick still have a total order after ``dump()`` —
+        journey stitching (obs/journey.py) sorts on it."""
         if len(self._ring) == self.capacity:
             self.n_dropped += 1
         ev = {"t": round(self.clock(), 6), "wall": round(time.time(), 6),
-              "kind": kind}
+              "seq": self.n_recorded, "kind": kind}
         ev.update(fields)
         self.n_recorded += 1
         self._ring.append(ev)
 
     def events(self, *, kind: str | None = None,
                last: int | None = None) -> list[dict]:
-        """Ring contents oldest-first, optionally filtered to one ``kind``
-        and/or truncated to the last ``n``."""
-        evs = [e for e in self._ring if kind is None or e["kind"] == kind]
+        """Ring contents in ``seq`` (recording) order, oldest first,
+        optionally filtered to one ``kind`` and/or truncated to the last
+        ``n``."""
+        evs = sorted((e for e in self._ring
+                      if kind is None or e["kind"] == kind),
+                     key=lambda e: e.get("seq", 0))
         return evs[-last:] if last is not None else evs
 
     def clear(self) -> None:

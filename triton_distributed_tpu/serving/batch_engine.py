@@ -56,6 +56,7 @@ from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.models.sampling import finite_logits_mask, sample_token
 from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.obs.blackbox import Blackbox
+from triton_distributed_tpu.obs.journey import JourneyRecorder
 from triton_distributed_tpu.obs.slo import (
     BREACH,
     STATE_LEVEL,
@@ -155,7 +156,8 @@ class BatchEngine:
                  nan_guard: bool = False, paged_attn: str = "fused",
                  prefix_cache: bool = True, windowed_metrics: bool = True,
                  blackbox: bool | int = True,
-                 tail_sampling: bool | TailSampler = True):
+                 tail_sampling: bool | TailSampler = True,
+                 journey: bool | JourneyRecorder = True):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
                 f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
@@ -195,6 +197,14 @@ class BatchEngine:
             self.sampler = tail_sampling
         else:
             self.sampler = TailSampler(seed=seed) if tail_sampling else None
+        # Request-journey recorder (obs/journey.py) — always-on causal
+        # timelines + latency attribution. A Fleet replaces this with ONE
+        # shared recorder across its replicas so a cross-replica requeue
+        # stays a single journey.
+        if isinstance(journey, JourneyRecorder):
+            self.journey = journey
+        else:
+            self.journey = JourneyRecorder() if journey else None
         self._slo = None
         self._slo_eval_interval_s = 1.0
         self._slo_next_eval = 0.0
@@ -360,6 +370,9 @@ class BatchEngine:
                                  slow=detail["slow"]["value"])
         _trace.instant("slo_transition", objective=obj.name, old=old,
                        new=new)
+        if self.journey is not None:
+            self.journey.global_event("slo", objective=obj.name, old=old,
+                                      new=new)
         if new == BREACH:
             self.metrics.inc("slo_breaches")
             if self._watchdog is not None:
@@ -450,6 +463,8 @@ class BatchEngine:
                                 "dropped": self.blackbox.n_dropped}
         if self.sampler is not None:
             snap["sampler"] = self.sampler.stats()
+        if self.journey is not None:
+            snap["journey"] = self.journey.stats()
         return snap
 
     def resilience_snapshot(self) -> dict:
@@ -490,6 +505,8 @@ class BatchEngine:
             out["sampler"] = self.sampler.stats()
             out["sampled_traces"] = [rt.as_dict() for rt in
                                      list(self.sampler.kept)[-8:]]
+        if self.journey is not None:
+            out["journey"] = self.journey.dump()
         return out
 
     def perfdb_sample(self) -> dict:
@@ -513,6 +530,8 @@ class BatchEngine:
                 out[k] = float(m[k])
         out["retraces"] = max(0.0, float(self.trace_counts["decode"]
                                          + self.trace_counts["prefill"] - 2))
+        if self.journey is not None:
+            out.update(self.journey.perfdb_sample())
         if self._controller is not None:
             out.update(self._controller.perfdb_sample())
         # Pool fragmentation (KVPool.fragmentation): lets block-size sweeps
@@ -578,6 +597,10 @@ class BatchEngine:
             if self.blackbox is not None:
                 self.blackbox.record("fault", site=site,
                                      attempt=attempt_i, error=str(exc))
+            if self.journey is not None:
+                self.journey.global_event("fault", site=site,
+                                          attempt=attempt_i,
+                                          error=str(exc))
 
         def on_recovery(seconds):
             self.metrics.inc("step_recoveries")
@@ -675,6 +698,11 @@ class BatchEngine:
         if self.sampler is not None:
             self.sampler.begin(req_id, prompt_len=len(prompt),
                                max_new_tokens=max_new_tokens)
+        if self.journey is not None:
+            # Direct engine submit: the opening wait is the scheduler
+            # queue (a fleet submit opens in "route" instead — fleet.py).
+            req.journey = self.journey.begin(req_id, phase="queue",
+                                             prompt_len=len(prompt))
         return req_id
 
     def adopt(self, req: Request) -> object:
@@ -701,6 +729,14 @@ class BatchEngine:
             self.sampler.begin(req.req_id, prompt_len=len(req.prompt),
                                max_new_tokens=req.max_new_tokens,
                                adopted=True)
+        if self.journey is not None:
+            # Fleet placements arrive with a live context (the route hop
+            # was recorded fleet-side); a standalone adopt opens fresh.
+            if getattr(req, "journey", None) is None:
+                req.journey = self.journey.begin(req.req_id, phase="queue",
+                                                 adopted=True)
+            else:
+                self.journey.event(req.req_id, "adopt")
         return req.req_id
 
     def drain(self, reason: str = "drain") -> list[Request]:
@@ -729,10 +765,18 @@ class BatchEngine:
             if self.sampler is not None:
                 self.sampler.event(s.req.req_id, "drain", slot=i,
                                    reason=reason)
+            if self.journey is not None:
+                self.journey.hop(s.req.req_id, "drain", reason=reason,
+                                 progress=s.offset)
             out.append(s.req)
         while len(self.scheduler):
             req = self.scheduler.pop()
             self.metrics.inc("drained_requests")
+            if self.journey is not None:
+                # Queue-drained requests hop too: their wait moves from
+                # this replica's queue to the fleet requeue bucket.
+                self.journey.hop(req.req_id, "drain", reason=reason,
+                                 progress=0)
             out.append(req)
         out.sort(key=lambda r: (r.arrival_seq
                                 if r.arrival_seq is not None else 0))
@@ -843,6 +887,10 @@ class BatchEngine:
                 self.sampler.event(req.req_id, "admit", ctx_len=len(ctx),
                                    cached=matched,
                                    readmit=req.n_preemptions > 0)
+            if self.journey is not None:
+                self.journey.event(req.req_id, "admit", ctx_len=len(ctx),
+                                   cached=matched,
+                                   readmit=req.n_preemptions > 0)
 
     def _preempt(self, idx: int):
         s = self._slots[idx]
@@ -859,6 +907,8 @@ class BatchEngine:
         if self.sampler is not None:
             self.sampler.event(s.req.req_id, "preempt", slot=idx,
                                progress=s.offset)
+        if self.journey is not None:
+            self.journey.hop(s.req.req_id, "preempt", progress=s.offset)
 
     def _ensure_or_preempt(self, idx: int) -> bool:
         """Grow slot ``idx``'s table for its next token write, evicting
@@ -918,9 +968,14 @@ class BatchEngine:
                                  tokens=len(s.req.output),
                                  preemptions=s.req.n_preemptions,
                                  e2e_s=round(e2e, 6))
+        kept = False
         if self.sampler is not None:
-            self.sampler.finish(s.req.req_id, latency_s=e2e,
-                                tokens=len(s.req.output))
+            kept = self.sampler.finish(s.req.req_id, latency_s=e2e,
+                                       tokens=len(s.req.output))
+        if self.journey is not None:
+            # The TailSampler verdict decides full-detail retention; the
+            # recorder force-keeps failed/displaced journeys on its own.
+            self.journey.finish(s.req.req_id, status="ok", keep=kept)
 
     def _quarantine(self, idx: int, reason: str):
         """Fail ONE request without failing the batch: release its blocks,
@@ -951,6 +1006,9 @@ class BatchEngine:
         if self.sampler is not None:
             self.sampler.finish(req.req_id, error=reason,
                                 tokens=len(req.output))
+        if self.journey is not None:
+            self.journey.finish(req.req_id, status="failed", error=reason,
+                                keep=True)
 
     def _record_token(self, s: _Slot, tok: int):
         s.req.output.append(tok)
@@ -1084,6 +1142,11 @@ class BatchEngine:
                 ids[i, :take] = s.ctx[s.offset:s.offset + take]
                 seq_lens[i] = take
                 pre_toks += take
+                if self.journey is not None:
+                    # Chunk consumption keyed by the budget in force, so
+                    # controller narrowing shows up per request.
+                    self.journey.event(s.req.req_id, "prefill_chunk",
+                                       tokens=take, budget=budget)
             else:
                 ids[i, 0] = s.last_tok
                 seq_lens[i] = 1
@@ -1115,9 +1178,14 @@ class BatchEngine:
             if s is None:
                 continue
             took = int(seq_lens[i])
+            was_prefilling = s.offset < len(s.ctx)
             s.offset += took
             if s.offset < len(s.ctx):
                 continue            # still mid-prompt; logits row is interim
+            if was_prefilling and self.journey is not None:
+                # This residency's prefill just completed: the journey
+                # phase flips to decode at the first emitted token.
+                self.journey.event(s.req.req_id, "decode_start")
             self._record_token(s, int(nxt[i]))
             if s.req.remaining_new == 0:
                 self._finish(i)

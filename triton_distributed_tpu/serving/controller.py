@@ -390,6 +390,16 @@ class Controller:
             "tick": self.n_ticks, "step": obs.get("step", 0),
             "knob": mv["knob"], "from": mv["from"], "to": mv["to"],
             "reason": mv["reason"], "level": obs["level"]})
+        # Stamp the knob delta into the plant's journey recorder: every
+        # request in flight at this step gets this action attached to its
+        # stitched timeline (obs/journey.py global events).
+        plant = self.engine if self.engine is not None else self.fleet
+        rec = getattr(plant, "journey", None) if plant is not None else None
+        if rec is not None:
+            rec.global_event("controller", step=obs.get("step", 0),
+                             knob=mv["knob"], from_=mv["from"],
+                             to=mv["to"], reason=mv["reason"],
+                             level=obs["level"])
 
     def tick(self, obs: dict) -> list[dict]:
         """One control iteration over an explicit observation: decide,

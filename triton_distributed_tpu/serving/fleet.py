@@ -60,6 +60,7 @@ import time
 import numpy as np
 
 from triton_distributed_tpu.obs import trace as _trace
+from triton_distributed_tpu.obs.journey import JourneyRecorder
 from triton_distributed_tpu.obs.slo import STATE_LEVEL
 from triton_distributed_tpu.resilience import faults as _faults
 from triton_distributed_tpu.resilience import guards as _guards
@@ -187,6 +188,16 @@ class Fleet:
         # land in one survivor's queue.
         self._arrival = itertools.count()
         self.state_log: list[dict] = []
+        # ONE journey recorder shared across every replica (replacing the
+        # per-engine ones), so a request that drains off replica A and
+        # finishes on replica B is a single stitched timeline. Disabled
+        # only when every engine was built with ``journey=False``.
+        if any(rep.engine.journey is not None for rep in self.replicas):
+            self.journey = JourneyRecorder()
+            for rep in self.replicas:
+                rep.engine.journey = self.journey
+        else:
+            self.journey = None
 
     # -- construction -------------------------------------------------------
 
@@ -249,6 +260,11 @@ class Fleet:
         self._pending.append(req)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
                            max_new_tokens=max_new_tokens)
+        if self.journey is not None:
+            # Fleet submits open in the "route" bucket: the first wait is
+            # for a placement decision, not a replica queue.
+            req.journey = self.journey.begin(req_id, phase="route",
+                                             prompt_len=len(prompt))
         return req_id
 
     # -- health machine -----------------------------------------------------
@@ -435,6 +451,9 @@ class Fleet:
         self.metrics.inc("requests_failed")
         _trace.async_end("request", req.req_id, failed=True,
                          error=req.error)
+        if self.journey is not None:
+            self.journey.finish(req.req_id, status="failed",
+                                error=req.error, keep=True)
 
     def _requeue(self, req: Request, reason: str) -> None:
         """Put a displaced request back in the fleet queue, or fail it with
@@ -451,6 +470,9 @@ class Fleet:
         self.metrics.inc("requeues")
         _trace.instant("requeue", req=req.req_id, attempt=len(chain),
                        reason=reason)
+        if self.journey is not None:
+            self.journey.event(req.req_id, "requeue", attempt=len(chain),
+                               reason=reason)
 
     # -- routing ------------------------------------------------------------
 
@@ -521,6 +543,18 @@ class Fleet:
                 self._pending = [req, *pending]
                 return placed
             rep = self.replicas[decision.replica]
+            if self.journey is not None:
+                # The route hop carries the WHOLE decision — winner score,
+                # every candidate's score and weighted component breakdown
+                # — so explain_request can show why this replica won.
+                self.journey.hop(
+                    req.req_id, "route", where=rep.idx,
+                    score=round(decision.score, 6),
+                    scores={str(k): round(v, 6)
+                            for k, v in decision.scores.items()},
+                    breakdown={str(k): {c: round(v, 6)
+                                        for c, v in comp.items()}
+                               for k, comp in decision.breakdown.items()})
             rep.engine.adopt(req)
             placed = True
             self.metrics.inc("requests_routed")
@@ -730,6 +764,8 @@ class Fleet:
             },
             **({"controller": self._controller.stats()}
                if self._controller is not None else {}),
+            **({"journey": self.journey.stats()}
+               if self.journey is not None else {}),
         }
 
     def perfdb_sample(self) -> dict:
@@ -739,9 +775,16 @@ class Fleet:
         out: dict = {}
         for rep in self.replicas:
             for k, v in rep.engine.perfdb_sample().items():
-                if k.endswith("_ms") or k.startswith("pool_"):
-                    continue      # latency/pool shape is per-replica
+                if (k.endswith("_ms") or k.startswith("pool_")
+                        or k.startswith("journey_")):
+                    # Latency/pool shape is per-replica; journey metrics
+                    # come from ONE recorder shared by every replica, so
+                    # summing would count the fleet N times (added once
+                    # below).
+                    continue
                 out[k] = out.get(k, 0.0) + float(v)
+        if self.journey is not None:
+            out.update(self.journey.perfdb_sample())
         fm = self.metrics.as_dict()
         out["requests_failed"] = (out.get("requests_failed", 0.0)
                                   + fm.get("requests_failed", 0.0))

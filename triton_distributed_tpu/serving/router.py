@@ -59,6 +59,12 @@ class RouteDecision:
     score: float
     signals: dict            # replica idx -> its signal dict
     scores: dict             # replica idx -> its score
+    # replica idx -> the weighted score components ({"cache", "headroom",
+    # "queue", "slo"} — penalties carry their sign, so the components sum
+    # to the score). The LOSERS' breakdowns ride along too: this is what
+    # ``tools/explain_request.py`` renders to answer *why* this replica
+    # won over the runner-up.
+    breakdown: dict = dataclasses.field(default_factory=dict)
 
 
 class Router:
@@ -104,12 +110,20 @@ class Router:
         self.slo_penalty = (ok, w, b)
         return self.slo_penalty
 
-    def score(self, sig: dict) -> float:
+    def score_components(self, sig: dict) -> dict:
+        """The four weighted terms of one candidate's score, signs
+        included (``sum(values) == score``). Kept per candidate on the
+        ``RouteDecision`` so a placement is explainable term by term."""
         level = min(max(int(sig.get("slo_level", 0)), 0), 2)
-        return (self.w_cache * float(sig.get("match_frac", 0.0))
-                + self.w_headroom * float(sig.get("headroom", 0.0))
-                - self.w_queue * float(sig.get("load", 0.0))
-                - self.slo_penalty[level])
+        return {
+            "cache": self.w_cache * float(sig.get("match_frac", 0.0)),
+            "headroom": self.w_headroom * float(sig.get("headroom", 0.0)),
+            "queue": -self.w_queue * float(sig.get("load", 0.0)),
+            "slo": -self.slo_penalty[level],
+        }
+
+    def score(self, sig: dict) -> float:
+        return sum(self.score_components(sig).values())
 
     def route(self, tokens, candidates) -> RouteDecision | None:
         """Place one request. ``candidates`` is a list of ``(key,
@@ -125,7 +139,9 @@ class Router:
         if not candidates:
             return None
         signals = {key: dict(sig) for key, sig in candidates}
-        scores = {key: self.score(sig) for key, sig in candidates}
+        breakdown = {key: self.score_components(sig)
+                     for key, sig in candidates}
+        scores = {key: sum(breakdown[key].values()) for key in breakdown}
         best_key = None
         best_rank = None
         for key, _sig in candidates:
@@ -139,4 +155,5 @@ class Router:
         self._last_routed[best_key] = self._clock
         self.n_routed += 1
         return RouteDecision(replica=best_key, score=scores[best_key],
-                             signals=signals, scores=scores)
+                             signals=signals, scores=scores,
+                             breakdown=breakdown)

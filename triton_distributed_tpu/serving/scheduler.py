@@ -67,6 +67,10 @@ class Request:
     # when the batch engine quarantines the request instead of crashing.
     status: str = "pending"
     error: str | None = None
+    # Journey trace context (obs/journey.JourneyContext): rides ON the
+    # request so hop numbering survives preemption, drain, and
+    # cross-replica requeue — one id space per request across the fleet.
+    journey: object | None = None
 
     @property
     def remaining_new(self) -> int:
