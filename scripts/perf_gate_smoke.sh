@@ -7,9 +7,13 @@
 #   2. python bench.py --perfdb          -> bench run (cpu-fallback on a
 #                                           no-TPU host, by design: this
 #                                           smoke must pass anywhere)
-#   3. python bench.py --paged-attn      -> fused-vs-gather paged decode
-#                                           byte ratio (analytic, runs
-#                                           anywhere; hard-checked <= 0.55)
+#   3. python bench.py --paged-attn      -> fused-vs-gather paged attention
+#                                           byte ratio over decode, pure-
+#                                           prefill, and mixed rows; run
+#                                           twice (default chunk and
+#                                           --prefill-chunk 16); analytic,
+#                                           runs anywhere; every row
+#                                           hard-checked <= 0.55
 #   4. python bench.py --probe-overhead  -> device-telemetry probed vs
 #                                           plain build step time (bit-
 #                                           identity asserted anywhere;
@@ -100,20 +104,30 @@ assert "backend" in obj and "metric" in obj, sorted(obj)
 EOF
 done
 
-for i in 1 2; do
-  echo "perf_gate_smoke: paged_attn run $i/2" >&2
-  python bench.py --paged-attn --perfdb "$DB" \
-    > "$WORKDIR/paged_attn_out.$i.json"
-  python - "$WORKDIR/paged_attn_out.$i.json" <<'EOF'
+# Two arms: the default-chunk shape and a longer prefill/mixed chunk.
+# The headline value is the WORST per-row (decode / prefill / mixed)
+# analytic byte ratio, so the <=0.55 bar binds on every step shape in
+# both arms (ISSUE 5 decode, ISSUE 14 chunked prefill + mixed).
+for chunk in "" 16; do
+  for i in 1 2; do
+    echo "perf_gate_smoke: paged_attn chunk='${chunk}' run $i/2" >&2
+    python bench.py --paged-attn ${chunk:+--prefill-chunk "$chunk"} \
+      --perfdb "$DB" > "$WORKDIR/paged_attn_out.${chunk:-d}.$i.json"
+    python - "$WORKDIR/paged_attn_out.${chunk:-d}.$i.json" <<'EOF'
 import json, sys
 line = open(sys.argv[1]).read().strip().splitlines()[-1]
 obj = json.loads(line)
 assert "backend" in obj and "metric" in obj, sorted(obj)
 assert obj.get("error") is None, obj.get("error")
 # The byte-ratio acceptance bar: fused must stay at or under ~55% of the
-# gather path's HBM bill (ISSUE 5). Analytic, so it is exact, not noisy.
+# gather path's HBM bill. Analytic, so it is exact, not noisy.
 assert obj["value"] is not None and obj["value"] <= 0.55, obj["value"]
+ex = obj.get("extras", {})
+for row in ("decode", "prefill", "mixed"):
+    assert ex.get(f"paged_attn_{row}_bytes_ratio", 1.0) <= 0.55, (row, ex)
+    assert ex.get(f"paged_attn_{row}_ledger_bytes_match") is True, (row, ex)
 EOF
+  done
 done
 
 for i in 1 2; do

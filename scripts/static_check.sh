@@ -43,17 +43,21 @@ from triton_distributed_tpu.analysis import checks, registry
 from triton_distributed_tpu.kernels import probes
 
 names = {e.name for e in registry.all_kernels()}
-missing = [f"{b}+probe" for b in probes.PROBE_BASES
-           if f"{b}+probe" not in names]
+# paged.* registers its probe variants itself (probe buffer sits mid-arg,
+# not appended) so it is not in PROBE_BASES — sweep it explicitly,
+# covering both the decode and the L>1 chunked-prefill grids.
+paged_bases = ("paged.decode", "paged.prefill")
+bases = tuple(probes.PROBE_BASES) + paged_bases
+missing = [f"{b}+probe" for b in bases if f"{b}+probe" not in names]
 assert not missing, f"unregistered probe variants: {missing}"
 bad = {}
-for b in probes.PROBE_BASES:
+for b in bases:
     for w in (2, 4, 8):
         vs = checks.check_kernel(f"{b}+probe", w)
         if vs:
             bad[(b, w)] = [str(v) for v in vs]
 assert not bad, bad
-print(f"{len(probes.PROBE_BASES)} probe variants registered and clean "
+print(f"{len(bases)} probe variants registered and clean "
       "at world 2/4/8.")
 EOF
 

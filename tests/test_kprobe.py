@@ -344,6 +344,42 @@ def test_paged_attention_bit_identity(rng):
             + s["pct_compute"]) == pytest.approx(100.0)
 
 
+def test_paged_prefill_probe_bit_identity(rng):
+    """probes=True on an L>1 chunked-prefill step: output bit-identical,
+    one probe step per (slot, q_tile, kv_tile) grid cell, and stall
+    attribution decodes — prefill is no longer a blind spot."""
+    from triton_distributed_tpu.kernels.paged_attention import (
+        paged_attention,
+    )
+
+    B, L, Hq, Hkv, dh, bs, max_blocks = 2, 8, 4, 2, 128, 8, 4
+    n_blocks = B * max_blocks
+    q = jnp.asarray(rng.standard_normal((B, L, Hq, dh)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((n_blocks, bs, Hkv, dh)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((n_blocks, bs, Hkv, dh)),
+                     jnp.float32)
+    tables = jnp.arange(n_blocks, dtype=jnp.int32).reshape(B, max_blocks)
+    kv_lens = jnp.asarray([max_blocks * bs, bs + 3], jnp.int32)
+    q_lens = jnp.asarray([L, 3], jnp.int32)        # ragged mixed step
+
+    off = paged_attention(q, kp, vp, tables, kv_lens, q_lens=q_lens,
+                          tile_blocks=2, q_tile=4, interpret=True)
+    on, pbuf = paged_attention(q, kp, vp, tables, kv_lens, q_lens=q_lens,
+                               tile_blocks=2, q_tile=4, interpret=True,
+                               probes=True)
+    assert np.array_equal(np.asarray(off), np.asarray(on))
+    tr = kprobe.decode(pbuf)
+    n_q_tiles = 2                                   # ceil(8 / 4)
+    assert (tr.rank, tr.world, tr.n_steps) == (0, 1, B * n_q_tiles * 2)
+    tot = tr.totals()
+    assert tot["dma_issue"] > 0 and tot["kflops"] > 0
+    assert tot["remote_bytes"] == 0 and tot["sem_spin"] == 0
+    s = kprobe.stall_summary(pbuf[None], hw=_HW)
+    assert (s["pct_dma_wait"] + s["pct_sem_spin"]
+            + s["pct_compute"]) == pytest.approx(100.0)
+
+
 @needs_tpu_interpret
 @pytest.mark.parametrize("kind", ["ag.ring", "ag.a2a", "ar.oneshot",
                                   "rs.oneshot", "rs.ring"])

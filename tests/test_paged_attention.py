@@ -1,20 +1,25 @@
-"""Fused paged-attention decode kernel (kernels/paged_attention.py).
+"""Fused paged-attention kernel, any query length
+(kernels/paged_attention.py).
 
 The load-bearing guarantees:
   1. the fused in-kernel block walk is numerically identical (interpret
      mode, f32) to the reference gather-then-dense composition across block
-     sizes (including a misaligned 128), ragged per-slot kv_lens, shuffled
-     block tables, dead slots, GQA ratios, and every feasible tile size;
-  2. ``nn.paged_attn_with_cache`` routes decode to the fused kernel and
-     mixed/prefill to the gather fallback, records a method-labelled
+     sizes (including a misaligned 128), query lengths (decode L=1,
+     chunked prefill, ragged mixed), ragged per-slot kv_lens, shuffled
+     block tables, dead slots, GQA ratios, q-tile splits (causal-boundary
+     straddles included), and every feasible kv tile size;
+  2. ``nn.paged_attn_with_cache`` routes EVERY step — decode, prefill, and
+     ragged mixed — to the fused kernel (the automatic gather fallback is
+     retired; ``paged_attn="gather"`` is the explicit oracle), records a
+     method-labelled (``fused_decode`` / ``fused_prefill`` / ``gather``)
      ``paged_attn`` comm-ledger series, and rejects bad flags/dtypes;
   3. end to end, a ``BatchEngine(paged_attn="fused")`` emits bit-identical
      greedy tokens to both the gather engine and the single-sequence golden
      Engine over >= 64 decode steps with pool churn and preemption, still
      with ONE compile per step shape;
   4. the fused path's byte accounting (perf_model / cost_estimate) is
-     <= ~55% of the gather path's, and the perf gate treats the ratio as
-     lower-is-better.
+     <= ~55% of the gather path's on decode AND prefill/mixed shapes, and
+     the perf gate treats the ratio as lower-is-better.
 """
 
 import jax
@@ -23,7 +28,9 @@ import numpy as np
 import pytest
 
 from triton_distributed_tpu.kernels.paged_attention import (
+    _feasible_qtiles,
     _feasible_tiles,
+    paged_attention,
     paged_attn_cost,
     paged_decode_attention,
     tuned_paged_tile,
@@ -54,6 +61,32 @@ def _ref_attn(q, kp, vp, tables, kv_lens, slot_mask=None):
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bhgs,bshd->bhgd", p, vv.astype(jnp.float32))
     return out.reshape(B, Hq, dh).astype(q.dtype)
+
+
+def _ref_attn_chunk(q, kp, vp, tables, kv_lens, q_lens):
+    """L-token causal reference: gather + per-row masked dense softmax.
+    Query row j of slot b sits at position kv_lens[b] - q_lens[b] + j;
+    rows past q_lens[b] are zeros (the varlen contract)."""
+    B, L, Hq, dh = q.shape
+    Hkv = kp.shape[2]
+    g = Hq // Hkv
+    kg = np.asarray(paged_gather_kv(kp, tables), np.float32)
+    vg = np.asarray(paged_gather_kv(vp, tables), np.float32)
+    qn = np.asarray(q, np.float32)
+    kv_lens = np.asarray(kv_lens)
+    q_lens = np.asarray(q_lens)
+    out = np.zeros((B, L, Hq, dh), np.float32)
+    for b in range(B):
+        for j in range(L):
+            if j >= q_lens[b]:
+                continue
+            hi = kv_lens[b] - q_lens[b] + j + 1        # exclusive causal end
+            for hq in range(Hq):
+                h = hq // g
+                s = (qn[b, j, hq] @ kg[b, :hi, h].T) * dh ** -0.5
+                p = np.exp(s - s.max())
+                out[b, j, hq] = (p / p.sum()) @ vg[b, :hi, h]
+    return out.astype(np.asarray(q).dtype)
 
 
 def _pool_case(rng, B, bs, Hkv, g, dh, max_blocks, ragged=True):
@@ -114,6 +147,80 @@ def test_fused_dead_slots_and_scalar_kvlen(rng):
                                atol=1e-5)
 
 
+@pytest.mark.parametrize("bs,max_blocks", [(8, 4), (16, 3), (128, 2)])
+@pytest.mark.parametrize("g", [1, 4])
+@pytest.mark.parametrize("L", [2, 7, 8])
+def test_fused_prefill_matches_gather_reference(rng, bs, max_blocks, g, L):
+    """The tentpole matrix: L > 1 chunked prefill through the fused kernel
+    equals the gather reference across block sizes (128 misaligned
+    included), GQA ratios, ragged kv_lens, and q-tile splits."""
+    B, Hkv, dh = 4, 2, 16
+    _, kp, vp, tables, _ = _pool_case(rng, B, bs, Hkv, g, dh, max_blocks)
+    Hq = Hkv * g
+    S = max_blocks * bs
+    q = jnp.asarray(rng.normal(size=(B, L, Hq, dh)), jnp.float32)
+    if bs == 128:
+        # the misaligned case: lengths that end mid-block / mid-lane-tile
+        kv_lens = jnp.asarray([L, 100, 129, 2 * 128 - 1], jnp.int32)
+    else:
+        kv_lens = jnp.asarray(rng.integers(L, S + 1, size=B), jnp.int32)
+    ref = _ref_attn_chunk(q, kp, vp, tables, kv_lens,
+                          jnp.full((B,), L, jnp.int32))
+    for q_tile in (None, 1, 4, L):
+        out = paged_attention(q, kp, vp, tables, kv_lens, q_tile=q_tile,
+                              interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f"q_tile={q_tile}")
+
+
+def test_fused_ragged_mixed_step_and_dead_slots(rng):
+    """One kernel call serving decode rows (q_len 1), partial-chunk rows,
+    and a dead slot — the ragged mixed step the engine actually runs."""
+    B, bs, Hkv, g, dh, max_blocks = 4, 8, 2, 2, 16, 4
+    _, kp, vp, tables, _ = _pool_case(rng, B, bs, Hkv, g, dh, max_blocks)
+    L = 8
+    q = jnp.asarray(rng.normal(size=(B, L, Hkv * g, dh)), jnp.float32)
+    q_lens = jnp.asarray([1, 8, 5, 3], jnp.int32)       # decode + chunks
+    offs = jnp.asarray([16, 0, 9, 2], jnp.int32)        # warm + cold starts
+    kv_lens = offs + q_lens
+    slot_mask = jnp.asarray([True, True, True, False])
+    out = paged_attention(q, kp, vp, tables, kv_lens, q_lens=q_lens,
+                          slot_mask=slot_mask, interpret=True)
+    masked_tables = jnp.where(slot_mask[:, None], tables, 0)
+    ref = _ref_attn_chunk(q, kp, vp, masked_tables, kv_lens, q_lens)
+    live = np.asarray(slot_mask)
+    np.testing.assert_allclose(np.asarray(out)[live],
+                               np.asarray(ref)[live], atol=1e-5)
+    assert np.isfinite(np.asarray(out)).all(), \
+        "dead slots must emit finite garbage, not NaN"
+    # padding rows past q_lens[b] are exact zeros (the varlen contract)
+    np.testing.assert_array_equal(np.asarray(out)[0, 1:], 0.0)
+    np.testing.assert_array_equal(np.asarray(out)[2, 5:], 0.0)
+
+
+def test_fused_prefill_causal_boundary_straddle(rng):
+    """A query tile straddling kv_len: with q_tile=4 and L=6 the second
+    tile holds live rows [4, 6) plus padding, and its causal frontier ends
+    mid-block — the DMA-skip limit, the per-row mask, and the padded tail
+    must all agree with the reference."""
+    B, bs, Hkv, g, dh, max_blocks = 2, 8, 2, 1, 16, 4
+    _, kp, vp, tables, _ = _pool_case(rng, B, bs, Hkv, g, dh, max_blocks)
+    L = 6
+    q = jnp.asarray(rng.normal(size=(B, L, Hkv * g, dh)), jnp.float32)
+    # slot 0: the whole sequence IS the chunk (kv_len == L < block_size);
+    # slot 1: frontier crosses a block edge inside the second q tile.
+    kv_lens = jnp.asarray([L, 19], jnp.int32)
+    ref = _ref_attn_chunk(q, kp, vp, tables, kv_lens,
+                          jnp.full((B,), L, jnp.int32))
+    for tile_blocks in (1, 2):
+        out = paged_attention(q, kp, vp, tables, kv_lens, q_tile=4,
+                              tile_blocks=tile_blocks, interpret=True)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-5, rtol=1e-5,
+                                   err_msg=f"tile_blocks={tile_blocks}")
+
+
 def test_fused_rejects_non_int32_tables(rng):
     q, kp, vp, tables, kv_lens = _pool_case(rng, 2, 8, 2, 1, 16, 2)
     with pytest.raises(TypeError, match="int32"):
@@ -148,7 +255,28 @@ def test_feasible_tiles_vmem_bounded():
 def test_tuned_paged_tile_deterministic_off_tpu():
     a = tuned_paged_tile(16, 2, 64, 8, "float32")
     assert a == tuned_paged_tile(16, 2, 64, 8, "float32")
-    assert a in _feasible_tiles(16, 2, 64, 8, 4)
+    tile, q_tile = a
+    assert tile in _feasible_tiles(16, 2, 64, 8, 4)
+    assert q_tile == 1                       # decode: single query row
+    # L > 1 gets its own cache key and a q tile covering the chunk when
+    # the staging buffers fit — one pool pass instead of one per q tile.
+    b = tuned_paged_tile(16, 2, 64, 8, "float32", L=8, g=2)
+    assert b == tuned_paged_tile(16, 2, 64, 8, "float32", L=8, g=2)
+    assert b[1] in _feasible_qtiles(8, 2, 2, 64, 4)
+    assert b[1] == 8
+    assert b != a or b[1] == 1               # distinct keys, no bleed-through
+
+
+def test_feasible_qtiles_vmem_bounded():
+    from triton_distributed_tpu.kernels import common
+    qts = _feasible_qtiles(64, 8, 2, 128, 2)
+    per_tok = 8 * 2 * 128 * (8 + 2)          # acc f32 + m/l f32 + q + out
+    assert qts and all(t * per_tok <= common.VMEM_STAGE_BUDGET for t in qts)
+    assert all(1 <= t <= 64 for t in qts)
+    assert _feasible_qtiles(1, 8, 2, 128, 2) == [1]
+    # huge heads: still returns a tile (degenerate geometry -> 1)
+    assert 1 in _feasible_qtiles(64, 64, 8, 256, 4) or \
+        _feasible_qtiles(64, 64, 8, 256, 4)
 
 
 # -- 2. layer entry point routing -------------------------------------------
@@ -172,7 +300,7 @@ def test_paged_attn_with_cache_fused_equals_gather(rng):
     # method-labelled ledger series with the analytic byte accounting
     series = {d["method"]: d for d in snap.values()
               if isinstance(d, dict) and d.get("collective") == "paged_attn"}
-    assert set(series) == {"fused", "gather"}
+    assert set(series) == {"fused_decode", "gather"}
     for method, entry in series.items():
         expect = pm.paged_attn_bytes(B, max_blocks, bs, Hkv, dh,
                                      n_q_heads=Hkv * g,
@@ -181,24 +309,42 @@ def test_paged_attn_with_cache_fused_equals_gather(rng):
         assert entry["bytes_total"] == expect, method
 
 
-def test_paged_attn_with_cache_prefill_falls_back_to_gather(rng):
-    """L > 1 (chunked prefill) must route to the gather path even with
-    paged_attn='fused' — and the ledger must say so."""
+def test_paged_attn_with_cache_prefill_routes_fused(rng):
+    """L > 1 (chunked prefill, ragged seq_lens, nonzero offsets) routes to
+    the fused kernel — the automatic gather fallback is retired — and the
+    ledger labels it fused_prefill with the analytic L>1 byte bill."""
     B, bs, Hkv, dh, max_blocks = 2, 8, 2, 16, 2
     _, kp, vp, tables, _ = _pool_case(rng, B, bs, Hkv, 1, dh, max_blocks)
     L = 4
     q = jnp.asarray(rng.normal(size=(B, L, Hkv, dh)), jnp.float32)
-    offset = jnp.zeros((B,), jnp.int32)
-    seq_lens = jnp.asarray([L, 2], jnp.int32)
+    offset = jnp.asarray([3, 0], jnp.int32)          # mixed warm/cold starts
+    seq_lens = jnp.asarray([L, 2], jnp.int32)        # ragged chunk lengths
     with comm_ledger.ledger(reset_first=True):
         out = nn.paged_attn_with_cache(q, kp, vp, tables, offset,
                                        scale=dh ** -0.5, seq_lens=seq_lens,
-                                       paged_attn="fused")
+                                       paged_attn="fused", interpret=True)
         snap = comm_ledger.snapshot()
     assert out.shape == (B, L, Hkv, dh)
     methods = {d["method"] for d in snap.values()
                if isinstance(d, dict) and d.get("collective") == "paged_attn"}
-    assert methods == {"gather"}
+    assert methods == {"fused_prefill"}
+    # the explicit escape hatch is the oracle
+    oracle = nn.paged_attn_with_cache(q, kp, vp, tables, offset,
+                                      scale=dh ** -0.5, seq_lens=seq_lens,
+                                      paged_attn="gather")
+    np.testing.assert_allclose(np.asarray(out), np.asarray(oracle),
+                               atol=1e-5, rtol=1e-5)
+    # ledger == analytic with the tuned q tile
+    _, q_tile = tuned_paged_tile(bs, Hkv, dh, max_blocks,
+                                 str(kp.dtype), L=L, g=1)
+    entry = next(d for d in snap.values()
+                 if isinstance(d, dict)
+                 and d.get("collective") == "paged_attn")
+    expect = pm.paged_attn_bytes(B, max_blocks, bs, Hkv, dh,
+                                 n_q_heads=Hkv,
+                                 itemsize=kp.dtype.itemsize,
+                                 method="fused_prefill", L=L, q_tile=q_tile)
+    assert entry["bytes_total"] == expect
 
 
 def test_paged_attn_flag_validation(rng):

@@ -186,12 +186,17 @@ def test_disabled_cache_is_inert(setup):
 
 # -- 2. end-to-end bit-identity ----------------------------------------------
 
-def test_warm_cache_bit_identical_with_churn(setup):
+@pytest.mark.parametrize("paged_attn", ["fused", "gather"])
+def test_warm_cache_bit_identical_with_churn(setup, paged_attn):
     """The acceptance bar: >=64 greedy decode steps through an
     oversubscribed engine (preemption churn), 8 requests sharing an
     8-token prefix in 4 prompt groups. Outputs must equal BOTH the
     single-sequence goldens and a prefix-cache-disabled engine's, the
-    warm engine must actually hit, and neither engine may retrace."""
+    warm engine must actually hit, and neither engine may retrace.
+    Parametrized over the attention path: 'fused' drives every warm
+    admission through the fused prefill kernel (the only routed path
+    since the gather auto-fallback was retired); 'gather' is the
+    escape-hatch oracle and must agree token-for-token."""
     _, config, engine = setup
     rng = np.random.default_rng(11)
     shared = rng.integers(0, config.vocab_size, size=8).tolist()
@@ -204,7 +209,8 @@ def test_warm_cache_bit_identical_with_churn(setup):
     outs = {}
     for label, caching in (("cold", False), ("warm", True)):
         be = BatchEngine(engine, n_slots=3, n_blocks=9, block_size=4,
-                         prefill_chunk=8, prefix_cache=caching)
+                         prefill_chunk=8, prefix_cache=caching,
+                         paged_attn=paged_attn)
         assert (be.prefix_cache is not None) == caching
         rids = [be.submit(p, max_new_tokens=gen) for p in prompts]
         done = be.run(max_steps=1000)

@@ -220,6 +220,24 @@ def test_paged_decode_config_sensitivity():
     assert {f.check for f in blown} == {"vmem-budget"}
 
 
+def test_paged_prefill_config_sensitivity():
+    """The (tile_blocks, q_tile) config space: a sane prefill config is
+    clean, and blowing up either axis trips the VMEM budget — the same
+    closure the ContextualAutotuner pruner uses for L > 1."""
+    ok = resources.check_kernel(
+        "paged.prefill", 1,
+        dict(tile_blocks=2, bs=16, n_kv=2, dh=128, max_blocks=4,
+             dtype="float32", L=8, q_tile=4), trace=False)
+    assert ok == []
+    for cfg in (dict(tile_blocks=2048, q_tile=4),     # kv staging blows
+                dict(tile_blocks=2, q_tile=4096)):    # q/acc staging blows
+        blown = resources.check_kernel(
+            "paged.prefill", 1,
+            dict(bs=16, n_kv=8, dh=128, max_blocks=2048,
+                 dtype="bfloat16", L=4096, **cfg), trace=False)
+        assert "vmem-budget" in {f.check for f in blown}, cfg
+
+
 def test_config_pruner_closure_feeds_autotuner(tmp_path, monkeypatch):
     """End-to-end: a ContextualAutotuner wired with the resources config
     pruner never compiles a VMEM-blowing paged.decode tile."""

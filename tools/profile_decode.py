@@ -2,11 +2,13 @@
 component of the qwen3-1.7b B=8 decode step separately (same methodology
 as bench.py), then compare the sum against the measured e2e step.
 
-``--probes``: instead of slope-timing, run the probed paged-decode
-attention build (kernels/probes.py), decode the device telemetry record
-with obs.kprobe, print the stall attribution, and write the per-step
-Chrome trace rows to ``--trace-dir`` (default /tmp/tdtpu_probe_trace).
-Runs on any backend (interpret mode off-TPU)."""
+``--probes``: instead of slope-timing, run the probed paged-attention
+build (kernels/probes.py), decode the device telemetry record with
+obs.kprobe, print the stall attribution, and write the per-step Chrome
+trace rows to ``--trace-dir`` (default /tmp/tdtpu_probe_trace).
+``--prefill N`` probes an N-token chunked-prefill step (causal
+(B, n_q_tiles, n_kv_tiles) grid) instead of the L=1 decode step. Runs on
+any backend (interpret mode off-TPU)."""
 import functools, time
 import os, sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -16,29 +18,31 @@ import jax, jax.numpy as jnp
 def _probes_mode():
     import numpy as np
     from triton_distributed_tpu.kernels.paged_attention import (
-        paged_decode_attention)
+        paged_attention)
     from triton_distributed_tpu.obs import kprobe
     from triton_distributed_tpu.runtime.utils import dist_print
 
     B, Hq, Hkv, dh, bs, max_blocks, tile = 8, 16, 8, 128, 16, 8, 4
+    L = int(sys.argv[sys.argv.index("--prefill") + 1]) \
+        if "--prefill" in sys.argv else 1
     n_blocks = B * max_blocks
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.normal(size=(B, Hq, dh)), jnp.float32)
+    q = jnp.asarray(rng.normal(size=(B, L, Hq, dh)), jnp.float32)
     kp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
     vp = jnp.asarray(rng.normal(size=(n_blocks, bs, Hkv, dh)), jnp.float32)
     tables = jnp.asarray(rng.permutation(n_blocks).reshape(B, max_blocks),
                          jnp.int32)
     kv_lens = jnp.asarray(
-        rng.integers(1, max_blocks * bs + 1, size=B), jnp.int32)
+        rng.integers(L, max_blocks * bs + 1, size=B), jnp.int32)
 
     t0 = time.perf_counter()
-    out, pbuf = paged_decode_attention(q, kp, vp, tables, kv_lens,
-                                       tile_blocks=tile, probes=True)
+    out, pbuf = paged_attention(q, kp, vp, tables, kv_lens,
+                                tile_blocks=tile, probes=True)
     jax.block_until_ready(out)
     wall_us = (time.perf_counter() - t0) * 1e6
 
     s = kprobe.stall_summary(np.asarray(pbuf)[None])
-    dist_print(f"paged_decode probe: {s['n_steps']} grid steps, "
+    dist_print(f"paged_attn probe (L={L}): {s['n_steps']} grid steps, "
                f"B={B} tiles/slot={max_blocks // tile}")
     dist_print(f"stall attribution: dma_wait {s['pct_dma_wait']:.1f}%  "
                f"sem_spin {s['pct_sem_spin']:.1f}%  "

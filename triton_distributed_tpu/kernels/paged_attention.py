@@ -1,36 +1,46 @@
-"""Fused paged-attention decode: walk the block table INSIDE the kernel.
+"""Fused paged attention: walk the block table INSIDE the kernel — any L.
 
-The serving decode path used to read the block-paged KV pool through
+The serving path used to read the block-paged KV pool through
 ``sp_attention.paged_gather_kv``, which materializes a contiguous
-``(B, max_blocks * block_size, Hkv, dh)`` copy of BOTH K and V every decode
-step, every layer, before attention runs — the pool bytes are read once to
-build the view, written once into it, and read again by the kernel: ~3x the
-KV HBM traffic of a single pass. This module is the Pallas upgrade path the
+``(B, max_blocks * block_size, Hkv, dh)`` copy of BOTH K and V every step,
+every layer, before attention runs — the pool bytes are read once to build
+the view, written once into it, and read again by the kernel: ~3x the KV
+HBM traffic of a single pass. This module is the Pallas upgrade path the
 gather docstring promised (and the move vLLM's PagedAttention / Flash-
 Decoding make): the kernel receives the block table via scalar prefetch,
 DMA-copies each sequence's pool blocks straight into VMEM staging, and runs
 the streaming-softmax accumulation of ``_flash_decode_kernel`` over the
-block grid — decode attention becomes HBM-bound on the VALID cache bytes
-only, with no materialized dense view at all.
+block grid — attention becomes HBM-bound on the VALID cache bytes only,
+with no materialized dense view at all.
 
-Scope: the single-token DECODE step (L == 1, the hot serving loop). Mixed /
-chunked-prefill steps keep the documented gather fallback
-(``layers.nn.paged_attn_with_cache`` routes them): a prefill chunk re-reads
-the whole prefix anyway, so the gather's extra pass is amortized over
-``prefill_chunk`` tokens there, while on the decode path it doubles the
-per-token bill — exactly where this kernel earns its bytes.
+Scope: EVERY query length. Decode (L == 1) is the original hot loop; since
+this kernel grew a query-tile grid dimension, chunked-prefill and ragged
+mixed steps route here too (``layers.nn.paged_attn_with_cache`` no longer
+falls back to the gather for L > 1 — ``paged_attn="gather"`` survives only
+as the explicit escape hatch / test oracle). Each query tile applies
+causal masking against the block table using the per-slot
+(``kv_lens``, ``q_lens``) pair: query row j of slot b sits at absolute
+position ``kv_lens[b] - q_lens[b] + j`` and attends keys up to itself, so
+earlier query tiles skip the DMAs for blocks past their own causal
+frontier — the fused prefill reads at most one causal pass of the prefix
+where the gather always bills three full ones.
 
-Grid: ``(B, n_tiles)`` with ``n_tiles = ceil(max_blocks / tile_blocks)``;
-the tile dimension is ``arbitrary`` (sequential) so the running
-(acc, max, denom) triple carries across tiles. Tiles entirely past a slot's
-``kv_len`` skip their DMAs AND their math (``pl.when`` on the scalar-
-prefetched length) — a short sequence in a long-table batch costs only its
-own bytes. Dead slots are routed to block 0 on the HOST (same semantics as
-the gather path) and their outputs discarded by the caller.
+Grid: ``(B, n_q_tiles, n_tiles)`` with ``n_tiles = ceil(max_blocks /
+tile_blocks)`` and ``n_q_tiles = ceil(L / q_tile)``; the kv-tile dimension
+is ``arbitrary`` (sequential) so the running (acc, max, denom) triple
+carries across kv tiles and re-initializes per (slot, q-tile). Tiles
+entirely past a slot's causal frontier skip their DMAs AND their math
+(``pl.when`` on the scalar-prefetched lengths) — a short sequence in a
+long-table batch costs only its own bytes. Dead slots are routed to block
+0 on the HOST (same semantics as the gather path) and their outputs
+discarded by the caller; padding query rows (j >= q_lens[b]) emit exact
+zeros, matching ``attn_with_cache``'s varlen contract.
 
-The block-grid tile size is a ``ContextualAutotuner`` config keyed on
-(block_size, Hkv, dh, max_blocks, dtype) — ``tuned_paged_tile`` — with a
-VMEM-bounded heuristic default off-TPU / under trace.
+The (kv-tile, q-tile) pair is a ``ContextualAutotuner`` config keyed on
+(block_size, Hkv, dh, max_blocks, L, g, dtype) — ``tuned_paged_tile`` —
+with a VMEM-bounded heuristic default off-TPU / under trace that covers
+the whole chunk in ONE query tile whenever the staging fits (fewest
+re-reads of the kv prefix: the entire point of fusing prefill).
 """
 
 from __future__ import annotations
@@ -50,22 +60,27 @@ _NEG_INF = -1e30
 
 
 # ---------------------------------------------------------------------------
-# Block-grid tile autotuning
+# (kv-tile, q-tile) config autotuning
 # ---------------------------------------------------------------------------
 
-# Candidate tile sizes (pool blocks staged per grid step). Preference order:
-# the VMEM-bounded heuristic winner is inserted first by tuned_paged_tile, so
-# off-TPU and trace-time callers get it deterministically.
+# Candidate kv tile sizes (pool blocks staged per grid step). Preference
+# order: the VMEM-bounded heuristic winner is inserted first by
+# _feasible_tiles, so off-TPU and trace-time callers get it
+# deterministically.
 _TILE_CANDIDATES = (8, 16, 4, 2, 1, 32)
+
+# Candidate query tile sizes (query TOKENS per grid step; each stages
+# q_tile * g query rows). The L-covering tile is always considered too.
+_QTILE_CANDIDATES = (64, 32, 16, 8, 4, 2, 1)
 
 
 def _feasible_tiles(block_size: int, n_kv_heads: int, head_dim: int,
                     max_blocks: int, itemsize: int) -> list[int]:
-    """Candidate tiles whose double (K+V) VMEM staging fits the collective
-    staging budget, capped at the table width; heuristic default first
-    (largest feasible tile staging <= 512 cache rows — enough DMA depth to
-    pipeline against the MXU without hogging VMEM, the flash-decode chunk
-    preference applied to blocks)."""
+    """Candidate kv tiles whose double (K+V) VMEM staging fits the
+    collective staging budget, capped at the table width; heuristic default
+    first (largest feasible tile staging <= 512 cache rows — enough DMA
+    depth to pipeline against the MXU without hogging VMEM, the
+    flash-decode chunk preference applied to blocks)."""
     per_block = 2 * block_size * n_kv_heads * head_dim * itemsize
     ok = [t for t in _TILE_CANDIDATES
           if t <= max(1, max_blocks)
@@ -76,17 +91,42 @@ def _feasible_tiles(block_size: int, n_kv_heads: int, head_dim: int,
     return [default] + [t for t in sorted(ok, reverse=True) if t != default]
 
 
+def _feasible_qtiles(L: int, n_kv_heads: int, g: int, head_dim: int,
+                     itemsize: int) -> list[int]:
+    """Candidate query tiles for an L-token chunk. Every query tile
+    re-walks the kv prefix up to its own causal frontier, so FEWER tiles
+    means fewer prefix re-reads — the default (first) is the
+    fewest-tiles feasible choice, ideally the whole chunk in one tile,
+    which is what keeps the fused prefill at ~1x pool traffic where the
+    gather bills 3x. Feasibility bounds the per-tile f32 accumulator +
+    f32 out block + wire-dtype q block by the staging budget."""
+    if L <= 1:
+        return [1]
+    per_tok = n_kv_heads * g * head_dim * (8 + itemsize)
+    ok = [t for t in _QTILE_CANDIDATES
+          if t <= L and t * per_tok <= common.VMEM_STAGE_BUDGET]
+    if L * per_tok <= common.VMEM_STAGE_BUDGET:
+        ok.append(L)
+    if not ok:
+        ok = [1]
+    return sorted(set(ok), key=lambda t: (-(-L // t), -t))
+
+
 def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
-                     max_blocks: int, dtype_str: str = "bfloat16") -> int:
-    """Block-grid tile size for ``paged_decode_attention``, contextual-
-    autotuner cached per (block_size, Hkv, dh, max_blocks, dtype).
+                     max_blocks: int, dtype_str: str = "bfloat16",
+                     L: int = 1, g: int = 2) -> tuple[int, int]:
+    """(tile_blocks, q_tile) config for ``paged_attention``, contextual-
+    autotuner cached per (block_size, Hkv, dh, max_blocks, L, g, dtype).
 
     Off-TPU or under an active jax trace the tuner never times: a cached
-    winner is used if one exists, else the VMEM-bounded heuristic default is
-    returned UNCOMMITTED (the autotuner commit discipline —
+    winner is used if one exists, else the VMEM-bounded heuristic default
+    is returned UNCOMMITTED (the autotuner commit discipline —
     runtime/autotuner.py ``_tune_matmul_blocks``). On a real TPU an eager
     call tunes the candidates over a synthetic pool at the live geometry
-    with the interleaved slope timer.
+    with the interleaved slope timer. The resource pruner evaluates each
+    candidate pair against the registered ``paged.decode`` /
+    ``paged.prefill`` trace spec so a VMEM-blowing (kv-tile, q-tile)
+    staging combination is rejected before it ever compiles.
     """
     from triton_distributed_tpu.runtime.autotuner import (
         ContextualAutotuner,
@@ -97,36 +137,41 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
     )
 
     itemsize = jnp.dtype(dtype_str).itemsize
-    cands = _feasible_tiles(block_size, n_kv_heads, head_dim, max_blocks,
-                            itemsize)
+    kv_cands = _feasible_tiles(block_size, n_kv_heads, head_dim, max_blocks,
+                               itemsize)
+    q_cands = _feasible_qtiles(L, n_kv_heads, g, head_dim, itemsize)
+    cands = [(t, qt) for qt in q_cands for t in kv_cands]
     if len(cands) == 1:
         return cands[0]
 
-    def resource_pruner(tile):
-        # Static VMEM/layout feasibility of one candidate tile, evaluated
-        # against the registered "paged.decode" trace spec at the live
-        # geometry — any finding rejects the tile before the tuner ever
-        # compiles it. Lazy import: the analysis layer must stay optional
-        # on the serving hot path.
+    def resource_pruner(cfg):
+        # Static VMEM/layout feasibility of one candidate pair, evaluated
+        # against the registered trace spec at the live geometry — any
+        # finding rejects the config before the tuner ever compiles it.
+        # Lazy import: the analysis layer must stay optional on the
+        # serving hot path.
         from triton_distributed_tpu.analysis import resources as _res
 
-        return _res.check_kernel(
-            "paged.decode", 1,
-            dict(tile_blocks=int(tile), bs=block_size, n_kv=n_kv_heads,
-                 dh=head_dim, max_blocks=max_blocks, dtype=dtype_str),
-            trace=False)
+        tile, q_tile = cfg
+        name = "paged.decode" if L == 1 else "paged.prefill"
+        kw = dict(tile_blocks=int(tile), bs=block_size, n_kv=n_kv_heads,
+                  dh=head_dim, max_blocks=max_blocks, dtype=dtype_str)
+        if L > 1:
+            kw.update(L=int(L), q_tile=int(q_tile), g=int(g))
+        return _res.check_kernel(name, 1, kw, trace=False)
 
-    tuner = ContextualAutotuner("paged_attn_tile", cands,
+    tuner = ContextualAutotuner("paged_attn_cfg", cands,
                                 multi_timer=interleaved_slope_timer,
                                 pruner=resource_pruner)
-    ctx = f"bs{block_size}:h{n_kv_heads}:d{head_dim}:mb{max_blocks}:{dtype_str}"
+    ctx = (f"bs{block_size}:h{n_kv_heads}:d{head_dim}:mb{max_blocks}"
+           f":L{L}:g{g}:{dtype_str}")
 
     if not on_tpu() or not _trace_state_clean():
         cached = tuner.peek(ctx)
-        return cached if cached is not None else cands[0]
+        return tuple(cached) if cached is not None else cands[0]
 
     def compute():
-        B, g = 8, 2
+        B = 8
         dtype = jnp.dtype(dtype_str)
         n_blocks = B * max_blocks
         key = jax.random.PRNGKey(0)
@@ -137,18 +182,21 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
             (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
         q = jax.random.normal(
             jax.random.fold_in(key, 2),
-            (B, n_kv_heads * g, head_dim)).astype(dtype)
+            (B, L, n_kv_heads * g, head_dim)).astype(dtype)
         tables = jnp.arange(B * max_blocks, dtype=jnp.int32).reshape(
             B, max_blocks)
         kv_lens = jnp.full((B,), max_blocks * block_size, jnp.int32)
+        q_lens = jnp.full((B,), min(L, max_blocks * block_size), jnp.int32)
 
-        def make_loop(tile):
+        def make_loop(cfg):
+            tile, q_tile = cfg
+
             @jax.jit
             def loop(q, n_iter):
                 def body(_, acc):
-                    out = paged_decode_attention(
+                    out = paged_attention(
                         acc.astype(q.dtype), kp, vp, tables, kv_lens,
-                        tile_blocks=tile)
+                        q_lens=q_lens, tile_blocks=tile, q_tile=q_tile)
                     return out.astype(jnp.float32)
                 return jax.lax.fori_loop(0, n_iter, body,
                                          q.astype(jnp.float32))
@@ -159,10 +207,10 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
         cfg = tuner.tune(make_loop, ctx)
         # tune() returns config 0 UNCACHED when every candidate timed out —
         # the memoized result must mirror that so a later call re-tunes.
-        return cfg, tuner._key(ctx) in _memory_cache
+        return tuple(cfg), tuner._key(ctx) in _memory_cache
 
-    return _memoized_blocks(("paged_tile", block_size, n_kv_heads, head_dim,
-                             max_blocks, dtype_str), compute)
+    return _memoized_blocks(("paged_cfg", block_size, n_kv_heads, head_dim,
+                             max_blocks, dtype_str, int(L), int(g)), compute)
 
 
 # ---------------------------------------------------------------------------
@@ -170,30 +218,40 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
 # ---------------------------------------------------------------------------
 
 
-def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
-                         k_buf, v_buf, acc_ref, m_ref, l_ref, sems, *,
-                         n_tiles: int, tile_blocks: int, bs: int,
-                         n_blocks: int, scale: float, n_kv: int,
-                         probe=_probes.NULL):
-    """One (slot, block-tile) grid step of fused paged decode attention.
+def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                       o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems, *,
+                       n_tiles: int, tile_blocks: int, bs: int,
+                       n_blocks: int, scale: float, n_kv: int, g: int,
+                       q_tile: int, n_q_tiles: int, probe=_probes.NULL):
+    """One (slot, query-tile, block-tile) grid step of fused paged
+    attention.
 
-    ``tbl_ref`` (B, max_blocks) int32 and ``kvlen_ref`` (B,) int32 arrive
-    via scalar prefetch (SMEM — readable before any DMA is issued, which is
-    the whole trick: the block ids ARE the gather, resolved in-kernel).
-    K/V pools stay in ANY/HBM; each tile DMA-copies its ``tile_blocks``
-    pool blocks into VMEM staging and runs the ``_flash_decode_kernel``
-    streaming-softmax update per kv head over the staged rows. Blocks past
-    ``kv_len`` skip their DMA entirely; the position mask zeroes whatever
-    stale staging rows the skipped fetch left behind (``jnp.where`` before
-    the max and the ``* valid`` guard on p scrub any NaN/Inf garbage).
+    ``tbl_ref`` (B, max_blocks) int32, ``kvlen_ref`` (B,) int32 and
+    ``qlen_ref`` (B,) int32 arrive via scalar prefetch (SMEM — readable
+    before any DMA is issued, which is the whole trick: the block ids ARE
+    the gather, resolved in-kernel). K/V pools stay in ANY/HBM; each tile
+    DMA-copies its ``tile_blocks`` pool blocks into VMEM staging and runs
+    the ``_flash_decode_kernel`` streaming-softmax update per kv head over
+    the staged rows. Blocks past this query tile's causal frontier skip
+    their DMA entirely; the row-liveness mask zeroes whatever stale staging
+    rows the skipped fetch left behind (``jnp.where`` before the PV dot and
+    the ``* valid`` guard on p scrub any NaN/Inf garbage).
     """
     b = pl.program_id(0)
-    t = pl.program_id(1)
-    # Single-device kernel: probe rank 0 / world 1; absolute (slot, tile)
-    # step so the decoder labels rows per batch slot.
-    probe.enter(b * n_tiles + t, 0, 1)
+    qt = pl.program_id(1)
+    t = pl.program_id(2)
+    # Single-device kernel: probe rank 0 / world 1; absolute (slot, q-tile,
+    # kv-tile) step so the decoder labels rows per batch slot.
+    probe.enter((b * n_q_tiles + qt) * n_tiles + t, 0, 1)
     kv_len = kvlen_ref[b]
+    q_len = qlen_ref[b]
     base = t * tile_blocks * bs
+    # Causal fetch ceiling for THIS query tile: its last live query row
+    # (local index jmax_p1 - 1) sits at absolute position
+    # kv_len - q_len + jmax_p1 - 1 and attends no key past itself, so later
+    # blocks skip their DMA — the causal half-read the byte model bills.
+    jmax_p1 = jnp.minimum((qt + 1) * q_tile, q_len)
+    limit = jnp.minimum(kv_len, kv_len - q_len + jmax_p1)
 
     @pl.when(t == 0)
     def _init():
@@ -201,11 +259,11 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
         m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
 
-    @pl.when(base < kv_len)
+    @pl.when((base < limit) & (qt * q_tile < q_len))
     def _work():
         # In-kernel block walk: the gather, without the materialized view.
         for i in range(tile_blocks):
-            @pl.when(base + i * bs < kv_len)
+            @pl.when(base + i * bs < limit)
             def _fetch(i=i):
                 # Same defensive clamp as the gather path's mode="clip".
                 blk = jnp.clip(tbl_ref[b, t * tile_blocks + i], 0,
@@ -218,35 +276,42 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
                                   probe=probe)
 
         # Staging rows whose block was never fetched hold garbage (NaN in
-        # interpret mode, stale VMEM on hardware). The score-side position
+        # interpret mode, stale VMEM on hardware). The score-side causal
         # mask scrubs stale K (a masked score is overwritten), but stale V
         # flows through the PV dot where ``0 * NaN = NaN`` — zero the dead
         # rows explicitly before contracting.
         row_pos = base + jax.lax.broadcasted_iota(
             jnp.int32, (tile_blocks * bs, 1), 0)
-        row_live = row_pos < kv_len                          # (T*bs, 1) bool
+        row_live = row_pos < limit                           # (T*bs, 1) bool
 
         for h in range(n_kv):
             # f32 casts deliberate — see _flash_decode_kernel: bf16 g-row
             # sub-tiles hit Mosaic's relayout path and measured slower.
-            q = q_ref[0, h].astype(jnp.float32)              # (g, dh)
+            q = q_ref[0, h].astype(jnp.float32)              # (q_tile*g, dh)
             k = k_buf[:, h, :].astype(jnp.float32)           # (T*bs, dh)
             # where, not multiply: 0 * NaN is still NaN.
             v = jnp.where(row_live, v_buf[:, h, :].astype(jnp.float32), 0.0)
             scores = jax.lax.dot_general(
-                q, k, (((1,), (1,)), ((), ()))) * scale      # (g, T*bs)
+                q, k, (((1,), (1,)), ((), ()))) * scale      # (q_tile*g, T*bs)
             pos = base + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-            valid = pos < kv_len
+            # Row r of the q block is query token j = qt*q_tile + r//g (the
+            # g query heads of one token share a kv head group); it may
+            # attend keys up to its own absolute position
+            # kv_len - q_len + j. Padding rows (j >= q_len) mask every key
+            # and emit exact zeros at _finish — the varlen contract.
+            j = (qt * q_tile
+                 + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0) // g)
+            valid = (j < q_len) & (pos <= kv_len - q_len + j)
             scores = jnp.where(valid, scores, _NEG_INF)
             seg_max = jnp.max(scores, axis=-1, keepdims=True)
             new_max = jnp.maximum(m_ref[h], seg_max)
             corr = jnp.exp(m_ref[h] - new_max)
-            # ``* valid``: a fully-masked tail has scores == new_max ==
+            # ``* valid``: a fully-masked row has scores == new_max ==
             # _NEG_INF and exp(0) == 1 would poison the denominator.
             p = jnp.exp(scores - new_max) * valid.astype(jnp.float32)
             l_ref[h] = l_ref[h] * corr + jnp.sum(p, axis=-1, keepdims=True)
             acc_ref[h] = acc_ref[h] * corr + jax.lax.dot_general(
-                p, v, (((1,), (0,)), ((), ())))              # (g, dh)
+                p, v, (((1,), (0,)), ((), ())))              # (q_tile*g, dh)
             m_ref[h] = new_max
         # QK^T + PV dots over the staged rows, all kv heads this tile.
         probe.compute(4 * n_kv * (q_ref.shape[2]) * tile_blocks * bs
@@ -254,60 +319,76 @@ def _paged_decode_kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref,
 
     @pl.when(t == n_tiles - 1)
     def _finish():
-        denom = jnp.maximum(l_ref[...], 1e-30)               # (n_kv, g, 1)
+        denom = jnp.maximum(l_ref[...], 1e-30)       # (n_kv, q_tile*g, 1)
         o_ref[0] = (acc_ref[...] / denom).astype(o_ref.dtype)
 
 
 def paged_attn_cost(B: int, max_blocks: int, block_size: int,
                     n_kv_heads: int, head_dim: int, *, n_q_heads: int,
-                    itemsize: int = 2):
-    """The fused kernel's cost estimate — ONE pass over the (worst-case
-    full-table) pool bytes plus q in wire dtype and the f32 out. The
-    acceptance comparison against the gather path's 3x KV bill lives in
-    ``runtime.perf_model.paged_attn_bytes`` (same arithmetic, both
-    methods)."""
-    kv = 2 * B * max_blocks * block_size * n_kv_heads * head_dim * itemsize
+                    itemsize: int = 2, L: int = 1,
+                    q_tile: int | None = None):
+    """The fused kernel's cost estimate — the causal per-q-tile pass over
+    the (worst-case full-table) pool bytes plus q in wire dtype and the f32
+    out, delegated to ``runtime.perf_model.paged_attn_bytes`` so the
+    estimate, the comm-ledger series, and the bench byte-ratio gate are one
+    arithmetic."""
+    from triton_distributed_tpu.runtime import perf_model as _pm
+
     return common.cost_estimate(
-        flops=4 * B * n_q_heads * max_blocks * block_size * head_dim,
-        bytes_accessed=B * n_q_heads * head_dim * (itemsize + 4) + kv)
+        flops=4 * B * L * n_q_heads * max_blocks * block_size * head_dim,
+        bytes_accessed=_pm.paged_attn_bytes(
+            B, max_blocks, block_size, n_kv_heads, head_dim,
+            n_q_heads=n_q_heads, itemsize=itemsize, method="fused", L=L,
+            q_tile=q_tile))
 
 
-def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
-                           slot_mask=None, scale: float | None = None,
-                           tile_blocks: int | None = None, interpret=None,
-                           probes: bool = False):
-    """GQA decode attention directly over a block-paged KV pool.
+def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
+                    q_lens=None, slot_mask=None, scale: float | None = None,
+                    tile_blocks: int | None = None,
+                    q_tile: int | None = None, interpret=None,
+                    probes: bool = False):
+    """GQA attention of an L-token query block per slot directly over a
+    block-paged KV pool — decode (L=1), chunked prefill, and ragged mixed
+    steps all through ONE kernel.
 
-    q:            (B, Hq, dh) — one new (rope'd) query row per slot.
+    q:            (B, L, Hq, dh) new (rope'd) query rows per slot; the new
+                  tokens' K/V are already in the pool
+                  (``nn.paged_cache_update`` runs first).
     k/v_pool:     (n_blocks, block_size, Hkv, dh) — ONE layer of this
-                  device's kv-head shard of ``serving.kv_pool.PagedKVState``
-                  (the new token's K/V already written via
-                  ``nn.paged_cache_update``).
+                  device's kv-head shard of ``serving.kv_pool.PagedKVState``.
     block_tables: (B, max_blocks) int32 — slot b's sequence occupies blocks
                   ``block_tables[b, :ceil(kv_lens[b]/block_size)]`` in
                   order; tail entries are allocator padding (never read:
                   their tiles skip the DMA).
     kv_lens:      () or (B,) int32 — valid cache length per slot INCLUDING
-                  the token just written (decode step: ``offset + 1``).
+                  this step's live tokens (``offset + q_lens``; decode:
+                  ``offset + 1``).
+    q_lens:       (B,) int32 or None — live query rows per slot (ragged
+                  mixed steps); None means all L rows are live. Query row
+                  j of slot b sits at absolute position
+                  ``kv_lens[b] - q_lens[b] + j`` and attends causally up to
+                  itself; rows past ``q_lens[b]`` emit exact zeros (the
+                  ``attn_with_cache`` varlen contract).
     slot_mask:    (B,) bool or None — dead slots' table rows are routed to
                   block 0 (the gather path's semantics: stale table entries
                   may point at blocks since reallocated to live sequences;
                   the mask keeps a dead slot from touching them at all).
                   The dead rows' outputs are garbage the caller discards.
-    tile_blocks:  pool blocks staged per grid step (None = autotuned /
-                  heuristic, ``tuned_paged_tile``).
+    tile_blocks / q_tile: pool blocks and query tokens staged per grid step
+                  (None = autotuned / heuristic, ``tuned_paged_tile``).
     probes:       device-telemetry build (a separate compile): returns
-                  ``(out, probe_buf)`` with one record row per (slot, tile)
-                  grid step, decoded by ``obs.kprobe``. The probed build
-                  serializes the slot dimension (``arbitrary`` semantics)
-                  so record ordinals are deterministic.
+                  ``(out, probe_buf)`` with one record row per (slot,
+                  q-tile, kv-tile) grid step, decoded by ``obs.kprobe`` —
+                  stall attribution covers prefill steps exactly like
+                  decode ones. The probed build serializes every grid
+                  dimension (``arbitrary`` semantics) so record ordinals
+                  are deterministic.
 
-    Returns (B, Hq, dh) in q.dtype. Bit-compatible with the reference
-    ``paged_gather_kv`` + dense/flash decode composition (streaming softmax
-    over the same masked positions); verified greedy-token-identical in
-    tests/test_paged_attention.py.
+    Returns (B, L, Hq, dh) in q.dtype. Bit-compatible with the reference
+    ``paged_gather_kv`` + dense/flash composition (streaming softmax over
+    the same masked positions); verified in tests/test_paged_attention.py.
     """
-    B, Hq, dh = q.shape
+    B, L, Hq, dh = q.shape
     n_blocks, bs, Hkv, _ = k_pool.shape
     if Hq % Hkv:
         raise ValueError(f"q heads {Hq} not divisible by kv heads {Hkv}")
@@ -324,44 +405,64 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
         block_tables = jnp.where(slot_mask[:, None], block_tables, 0)
     kv_lens = jnp.broadcast_to(
         jnp.asarray(kv_lens, jnp.int32).reshape(-1), (B,))
-    if tile_blocks is None:
-        tile_blocks = tuned_paged_tile(bs, Hkv, dh, max_blocks,
-                                       str(k_pool.dtype))
-    tile_blocks = max(1, min(tile_blocks, max_blocks))
+    if q_lens is None:
+        q_lens = jnp.full((B,), L, jnp.int32)
+    else:
+        q_lens = jnp.broadcast_to(
+            jnp.asarray(q_lens, jnp.int32).reshape(-1), (B,))
+    if tile_blocks is None or q_tile is None:
+        t_cfg, qt_cfg = tuned_paged_tile(bs, Hkv, dh, max_blocks,
+                                         str(k_pool.dtype), L=L, g=g)
+        tile_blocks = t_cfg if tile_blocks is None else tile_blocks
+        q_tile = qt_cfg if q_tile is None else q_tile
+    tile_blocks = max(1, min(int(tile_blocks), max_blocks))
+    q_tile = max(1, min(int(q_tile), L))
     n_tiles = pl.cdiv(max_blocks, tile_blocks)
+    n_q_tiles = pl.cdiv(L, q_tile)
     # Pad the table on the right so the last tile's static fetch loop can
     # index it; padded entries sit past every kv_len and never DMA.
     pad = n_tiles * tile_blocks - max_blocks
     if pad:
         block_tables = jnp.pad(block_tables, ((0, 0), (0, pad)))
 
-    qg = q.reshape(B, Hkv, g, dh)
-    kernel = functools.partial(_paged_decode_kernel, n_tiles=n_tiles,
+    L_pad = n_q_tiles * q_tile
+    rows = q_tile * g
+    qh = q.reshape(B, L, Hkv, g, dh)
+    if L_pad != L:
+        qh = jnp.pad(qh, ((0, 0), (0, L_pad - L), (0, 0), (0, 0), (0, 0)))
+    # (B, Hkv, L_pad*g, dh): kv-head major so one (1, Hkv, q_tile*g, dh)
+    # block serves each (slot, q-tile) grid step; row r of a block is query
+    # token r // g, head group r % g — the layout the in-kernel GQA causal
+    # mask assumes.
+    qh = qh.transpose(0, 2, 1, 3, 4).reshape(B, Hkv, L_pad * g, dh)
+
+    kernel = functools.partial(_paged_attn_kernel, n_tiles=n_tiles,
                                tile_blocks=tile_blocks, bs=bs,
-                               n_blocks=n_blocks, scale=scale, n_kv=Hkv)
-    out_specs = pl.BlockSpec((1, Hkv, g, dh),
-                             lambda b, t, tbl, kl: (b, 0, 0, 0))
-    out_shape = jax.ShapeDtypeStruct((B, Hkv, g, dh), jnp.float32)
+                               n_blocks=n_blocks, scale=scale, n_kv=Hkv,
+                               g=g, q_tile=q_tile, n_q_tiles=n_q_tiles)
+    out_specs = pl.BlockSpec((1, Hkv, rows, dh),
+                             lambda b, qt, t, tbl, kl, ql: (b, 0, qt, 0))
+    out_shape = jax.ShapeDtypeStruct((B, Hkv, L_pad * g, dh), jnp.float32)
     scratch_shapes = [
         pltpu.VMEM((tile_blocks * bs, Hkv, dh), k_pool.dtype),  # k stage
         pltpu.VMEM((tile_blocks * bs, Hkv, dh), v_pool.dtype),  # v stage
-        pltpu.VMEM((Hkv, g, dh), jnp.float32),   # acc
-        pltpu.VMEM((Hkv, g, 1), jnp.float32),    # running max
-        pltpu.VMEM((Hkv, g, 1), jnp.float32),    # denominator
+        pltpu.VMEM((Hkv, rows, dh), jnp.float32),   # acc
+        pltpu.VMEM((Hkv, rows, 1), jnp.float32),    # running max
+        pltpu.VMEM((Hkv, rows, 1), jnp.float32),    # denominator
         common.dma_sems(2),
     ]
-    # The probed build serializes the slot dimension so the single ordinal
-    # counter ticks in deterministic grid order.
-    dim_sems = ("arbitrary", "arbitrary") if probes \
-        else ("parallel", "arbitrary")
+    # The probed build serializes every grid dimension so the single
+    # ordinal counter ticks in deterministic grid order.
+    dim_sems = ("arbitrary", "arbitrary", "arbitrary") if probes \
+        else ("parallel", "arbitrary", "arbitrary")
     if probes:
-        n_steps = B * n_tiles
+        n_steps = B * n_q_tiles * n_tiles
 
-        def body(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref, pbuf,
-                 k_buf, v_buf, acc_ref, m_ref, l_ref, sems, pord,
-                 kernel=kernel):
-            kernel(tbl_ref, kvlen_ref, q_ref, kp_ref, vp_ref, o_ref, k_buf,
-                   v_buf, acc_ref, m_ref, l_ref, sems,
+        def body(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                 o_ref, pbuf, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
+                 pord, kernel=kernel):
+            kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                   o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
                    probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
 
         kernel = body
@@ -369,10 +470,11 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
         scratch_shapes = [*scratch_shapes, _probes.ord_scratch()]
         out_shape = [out_shape, _probes.out_shape(n_steps)]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B, n_tiles),
+        num_scalar_prefetch=3,
+        grid=(B, n_q_tiles, n_tiles),
         in_specs=[
-            pl.BlockSpec((1, Hkv, g, dh), lambda b, t, tbl, kl: (b, 0, 0, 0)),
+            pl.BlockSpec((1, Hkv, rows, dh),
+                         lambda b, qt, t, tbl, kl, ql: (b, 0, qt, 0)),
             common.any_spec(),     # k pool: manual per-block DMA
             common.any_spec(),     # v pool
         ],
@@ -387,13 +489,38 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
             dimension_semantics=dim_sems),
         cost_estimate=paged_attn_cost(
             B, max_blocks, bs, Hkv, dh, n_q_heads=Hq,
-            itemsize=k_pool.dtype.itemsize),
+            itemsize=k_pool.dtype.itemsize, L=L, q_tile=q_tile),
         interpret=resolve_interpret(interpret),
-    )(block_tables, kv_lens, qg, k_pool, v_pool)
+    )(block_tables, kv_lens, q_lens, qh, k_pool, v_pool)
+    o = outs[0] if probes else outs
+    o = o.reshape(B, Hkv, L_pad, g, dh).transpose(0, 2, 1, 3, 4)
+    o = o.reshape(B, L_pad, Hq, dh)[:, :L].astype(q.dtype)
     if probes:
-        out = outs[0].reshape(B, Hq, dh).astype(q.dtype)
-        return out, outs[1]
-    return outs.reshape(B, Hq, dh).astype(q.dtype)
+        return o, outs[1]
+    return o
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
+                           slot_mask=None, scale: float | None = None,
+                           tile_blocks: int | None = None, interpret=None,
+                           probes: bool = False):
+    """Single-token (L == 1) entry point over ``paged_attention`` — the
+    decode hot loop's shape, kept for the callers that think in one query
+    row per slot (bench's probe arm, tools/profile_decode, the autotuner
+    loop, tests). q (B, Hq, dh) -> (B, Hq, dh) in q.dtype; ``kv_lens`` is
+    the valid cache length INCLUDING the token just written
+    (``offset + 1``). Semantics otherwise identical to ``paged_attention``
+    with L = 1 (one query tile, causal mask degenerate to
+    ``pos < kv_len``)."""
+    B, Hq, dh = q.shape
+    out = paged_attention(q[:, None], k_pool, v_pool, block_tables,
+                          kv_lens, slot_mask=slot_mask, scale=scale,
+                          tile_blocks=tile_blocks, q_tile=1,
+                          interpret=interpret, probes=probes)
+    if probes:
+        o, pbuf = out
+        return o.reshape(B, Hq, dh), pbuf
+    return out.reshape(B, Hq, dh)
 
 
 # ---------------------------------------------------------------------------
@@ -401,36 +528,45 @@ def paged_decode_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
 #
 # Single-device kernel (ranks=1; the sweep's world sizes are slot counts
 # elsewhere and ignored here, like ar.oneshot_loopback). The build accepts
-# the autotuner's config as kwargs — ``tile_blocks`` plus the live pool
-# geometry — which is what lets ``analysis.resources.check_resources``
-# evaluate a candidate config's VMEM staging footprint, tile legality, and
-# grid×block coverage of the output BEFORE the tuner ever compiles it
-# (``tuned_paged_tile`` wires it in as the ContextualAutotuner pruner).
+# the autotuner's config as kwargs — ``tile_blocks``/``q_tile`` plus the
+# live pool geometry — which is what lets
+# ``analysis.resources.check_resources`` evaluate a candidate config's VMEM
+# staging footprint, tile legality, and grid×block coverage of the output
+# BEFORE the tuner ever compiles it (``tuned_paged_tile`` wires it in as
+# the ContextualAutotuner pruner). ``paged.decode`` is the L = 1 shape,
+# ``paged.prefill`` the L > 1 / multi-q-tile one; both carry ``+probe``
+# variants proving the instrumented choreography stays as clean as the
+# base.
 # ---------------------------------------------------------------------------
 
 from triton_distributed_tpu.analysis import registry as _comm  # noqa: E402
 import numpy as _np  # noqa: E402
 
 
-def _paged_trace_body(tbl, kvlen, q, kp, vp, o, k_buf, v_buf, acc, m_run,
-                      l_run, sems, **kw):
-    # Apply the (1, Hkv, g, dh) q/o BlockSpec windows by hand — the tracer
-    # passes whole buffers, the real grid_spec passes per-slot blocks.
+def _paged_trace_body(tbl, kvlen, qlen, q, kp, vp, o, k_buf, v_buf, acc,
+                      m_run, l_run, sems, **kw):
+    # Apply the (1, Hkv, q_tile*g, dh) q/o BlockSpec windows by hand — the
+    # tracer passes whole buffers, the real grid_spec passes per-(slot,
+    # q-tile) blocks.
     b = int(pl.program_id(0))
-    _paged_decode_kernel(tbl, kvlen, q.at[pl.ds(b, 1)], kp, vp,
-                         o.at[pl.ds(b, 1)], k_buf, v_buf, acc, m_run,
-                         l_run, sems, **kw)
+    qt = int(pl.program_id(1))
+    rows = kw["q_tile"] * kw["g"]
+    qw = q.at[pl.ds(b, 1), :, pl.ds(qt * rows, rows)]
+    ow = o.at[pl.ds(b, 1), :, pl.ds(qt * rows, rows)]
+    _paged_attn_kernel(tbl, kvlen, qlen, qw, kp, vp, ow, k_buf, v_buf, acc,
+                       m_run, l_run, sems, **kw)
 
 
-@_comm.register("paged.decode")
-def _comm_spec_paged(world: int, *, tile_blocks: int = 2, bs: int = 16,
-                     n_kv: int = 2, g: int = 2, dh: int = 128,
-                     max_blocks: int = 4,
-                     dtype: str = "float32") -> "_comm.TraceSpec":
+def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
+                n_kv: int = 2, g: int = 2, dh: int = 128,
+                max_blocks: int = 4, dtype: str = "float32", L: int = 1,
+                q_tile: int = 1) -> "_comm.TraceSpec":
     B = 2
     dt = _np.dtype(jnp.dtype(dtype))
     n_blocks = B * max_blocks
     n_tiles = -(-max_blocks // tile_blocks)
+    n_q_tiles = -(-L // q_tile)
+    rows = q_tile * g
     tbl_w = n_tiles * tile_blocks     # host-side right padding, never read
 
     def tables(r, w):
@@ -442,30 +578,82 @@ def _comm_spec_paged(world: int, *, tile_blocks: int = 2, bs: int = 16,
     return _comm.TraceSpec(
         body=_paged_trace_body,
         ranks=1,
-        grid=(B, n_tiles),
+        grid=(B, n_q_tiles, n_tiles),
         args=[
             _comm.Buf("tbl", (B, tbl_w), _np.int32, space="smem",
                       init=tables),
             _comm.Buf("kvlen", (B,), _np.int32, space="smem",
                       init=lambda r, w: _np.full((B,), max_blocks * bs,
                                                  _np.int32)),
-            _comm.Buf("q", (B, n_kv, g, dh), dt),
+            _comm.Buf("qlen", (B,), _np.int32, space="smem",
+                      init=lambda r, w: _np.full((B,), L, _np.int32)),
+            _comm.Buf("q", (B, n_kv, n_q_tiles * rows, dh), dt),
             _comm.Buf("kp", (n_blocks, bs, n_kv, dh), dt),
             _comm.Buf("vp", (n_blocks, bs, n_kv, dh), dt),
-            # One (1, Hkv, g, dh) window of q and o is VMEM-resident per
-            # grid step; billing the full B=2 buffers stays within a few
-            # KiB of that and keeps the declaration honest.
-            _comm.Buf("o", (B, n_kv, g, dh), _np.float32, space="vmem",
-                      covered=True),
+            # One (1, Hkv, q_tile*g, dh) window of q and o is VMEM-resident
+            # per grid step; billing the full B=2 buffers stays within a
+            # few KiB of that and keeps the declaration honest.
+            _comm.Buf("o", (B, n_kv, n_q_tiles * rows, dh), _np.float32,
+                      space="vmem", covered=True),
             _comm.Buf("k_buf", (tile_blocks * bs, n_kv, dh), dt,
                       space="vmem"),
             _comm.Buf("v_buf", (tile_blocks * bs, n_kv, dh), dt,
                       space="vmem"),
-            _comm.Buf("acc", (n_kv, g, dh), _np.float32, space="vmem"),
-            _comm.Buf("m_run", (n_kv, g, 1), _np.float32, space="vmem"),
-            _comm.Buf("l_run", (n_kv, g, 1), _np.float32, space="vmem"),
+            _comm.Buf("acc", (n_kv, rows, dh), _np.float32, space="vmem"),
+            _comm.Buf("m_run", (n_kv, rows, 1), _np.float32, space="vmem"),
+            _comm.Buf("l_run", (n_kv, rows, 1), _np.float32, space="vmem"),
             _comm.Sem("sems", (2,)),
         ],
         kwargs=dict(n_tiles=n_tiles, tile_blocks=tile_blocks, bs=bs,
-                    n_blocks=n_blocks, scale=1.0, n_kv=n_kv),
+                    n_blocks=n_blocks, scale=1.0, n_kv=n_kv, g=g,
+                    q_tile=q_tile, n_q_tiles=n_q_tiles),
     )
+
+
+_comm.register("paged.decode")(_paged_spec)
+
+
+@_comm.register("paged.prefill")
+def _paged_spec_prefill(world: int, *, L: int = 8, q_tile: int = 4,
+                        **kw) -> "_comm.TraceSpec":
+    """The L > 1 (chunked-prefill / mixed step) shape: two query tiles by
+    default so the (B, n_q_tiles, n_kv_tiles) grid, the per-tile causal
+    frontier, and the DMA skip are all exercised; same config kwargs as
+    ``paged.decode`` plus (L, q_tile) — the space the (tile_blocks, q_tile)
+    autotuner pruner feeds."""
+    return _paged_spec(world, L=L, q_tile=q_tile, **kw)
+
+
+def _register_paged_probe(base_name: str) -> None:
+    # The generic probes._register_probe_variant appends both probe refs at
+    # the end of the arg list; the real probed paged build places probe_buf
+    # right after the o output and probe_ord after the scratch refs — the
+    # wrapper here mirrors that exact order so the analyzer proves the
+    # choreography the hardware actually runs.
+    @_comm.register(f"{base_name}+probe")
+    def _build(world: int, _base=base_name, **cfg) -> "_comm.TraceSpec":
+        spec = _comm.get(_base).build(world, **cfg)
+        n_steps = 1
+        for n in spec.grid:
+            n_steps *= int(n)
+
+        def body(tbl, kvlen, qlen, q, kp, vp, o, pbuf, k_buf, v_buf, acc,
+                 m_run, l_run, sems, pord, **kw):
+            _paged_trace_body(
+                tbl, kvlen, qlen, q, kp, vp, o, k_buf, v_buf, acc, m_run,
+                l_run, sems,
+                probe=_probes.Probe(pbuf, pord, n_steps=n_steps), **kw)
+
+        args = list(spec.args)
+        args.insert(7, _comm.Buf(
+            "probe_buf", (_probes.n_rows(n_steps), _probes.N_FIELDS),
+            _np.int32, space="smem"))
+        args.append(_comm.Buf("probe_ord", (1,), _np.int32, space="smem"))
+        return _comm.TraceSpec(body=body, args=args, grid=spec.grid,
+                               kwargs=dict(spec.kwargs), ranks=spec.ranks,
+                               axes=spec.axes)
+
+
+for _base in ("paged.decode", "paged.prefill"):
+    _register_paged_probe(_base)
+del _base

@@ -816,13 +816,13 @@ def paged_gather_kv(pool, block_tables, *, slot_mask=None):
     positions >= the slot offset), but the mask keeps a dead slot from
     touching live sequences' blocks at all.
 
-    This is now the REFERENCE / fallback read path: single-token decode
-    routes through the fused in-kernel block walk
-    (``kernels.paged_attention.paged_decode_attention`` — no materialized
-    view, one pass over the pool bytes) via ``nn.paged_attn_with_cache``;
-    the gather stays for mixed/chunked-prefill steps (the extra pass
-    amortizes over the chunk) and as the ``paged_attn="gather"`` escape
-    hatch the fused kernel is verified token-identical against.
+    This is now the REFERENCE path only: every step shape — decode,
+    chunked prefill, ragged mixed — routes through the fused in-kernel
+    block walk (``kernels.paged_attention.paged_attention`` — no
+    materialized view, one pass over the pool bytes) via
+    ``nn.paged_attn_with_cache``. The gather survives solely behind the
+    explicit ``paged_attn="gather"`` escape hatch, the test oracle the
+    fused kernel is verified token-identical against.
     """
     if block_tables.dtype != jnp.int32:
         raise TypeError(

@@ -214,20 +214,18 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
                           use_flash_decode: bool = True, seq_lens=None,
                           interpret=None, paged_attn: str = "fused"):
     """GQA attention of new queries against a BLOCK-PAGED KV pool — the
-    paged twin of ``attn_with_cache``, and the router between the fused
-    Pallas kernel and the gather fallback.
+    paged twin of ``attn_with_cache``.
 
-    The single-token decode step (L == 1, no ``seq_lens``) routes through
-    ``kernels.paged_attention.paged_decode_attention``: the kernel walks the
-    scalar-prefetched block table itself, so the pool bytes are read ONCE —
-    no materialized ``(B, max_blocks*block_size, Hkv, dh)`` view. Mixed /
-    chunked-prefill steps (L > 1 or ragged ``seq_lens``) keep the documented
-    gather fallback (``paged_gather_kv`` + ``attn_with_cache``): a prefill
-    chunk re-reads the whole prefix anyway, so the gather's extra pass
-    amortizes over the chunk there, while on the decode path it triples the
-    per-token KV bill. ``paged_attn="gather"`` forces the fallback
-    everywhere (the escape hatch / reference path the fused kernel is
-    verified greedy-token-identical against).
+    EVERY step routes through ``kernels.paged_attention.paged_attention``:
+    the kernel walks the scalar-prefetched block table itself, so the pool
+    bytes are read ONCE per causal query tile — no materialized
+    ``(B, max_blocks*block_size, Hkv, dh)`` view. That covers the
+    single-token decode step (L == 1), pure chunked prefill, and ragged
+    mixed steps (``seq_lens`` per-row live query counts) alike; the
+    automatic gather fallback for L > 1 is retired. ``paged_attn="gather"``
+    forces the old path everywhere (``paged_gather_kv`` +
+    ``attn_with_cache`` — the escape hatch / reference oracle the fused
+    kernel is verified greedy-token-identical against; 3x the KV bill).
 
     q:            (B, L, Hq, dh) new queries (rope'd); the new tokens' K/V
                   are already in the pool (``paged_cache_update`` runs
@@ -238,39 +236,63 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
     outputs are garbage the serving engine discards). -> (B, L, Hq, dh).
 
     When the comm ledger is enabled, records a ``paged_attn`` series with
-    the analytic ``perf_model.paged_attn_bytes`` for whichever method ran —
-    the roofline classifies it HBM-bound (one pool touch), and the bench
-    ``paged_attn`` arm gates the fused/gather byte ratio.
+    the analytic ``perf_model.paged_attn_bytes`` for whichever method ran
+    (``fused_decode`` / ``fused_prefill`` / ``gather``) — the roofline
+    classifies it HBM-bound (one pool touch), and the bench ``paged_attn``
+    arm gates the fused/gather byte ratio on decode, pure-prefill, and
+    mixed rows.
     """
     if paged_attn not in ("fused", "gather"):
         raise ValueError(
             f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
     B, L, Hq, dh = q.shape
-    fused = paged_attn == "fused" and L == 1 and seq_lens is None
+    fused = paged_attn == "fused"
+    Hkv = k_pool.shape[2]
 
     from triton_distributed_tpu.obs import comm_ledger as _ledger
 
     if _ledger.enabled():
         from triton_distributed_tpu.runtime import perf_model as pm
 
-        method = "fused" if fused else "gather"
+        q_tile = None
+        if not fused:
+            method = "gather"
+        elif L == 1:
+            method = "fused_decode"
+        else:
+            from triton_distributed_tpu.kernels.paged_attention import (
+                tuned_paged_tile,
+            )
+
+            method = "fused_prefill"
+            # The exact q_tile the kernel will run (memoized/deterministic
+            # off-TPU), so the ledger equals the analytic model.
+            _, q_tile = tuned_paged_tile(
+                k_pool.shape[1], Hkv, dh, block_tables.shape[1],
+                str(k_pool.dtype), L=L, g=Hq // Hkv)
         nbytes = pm.paged_attn_bytes(
-            B, block_tables.shape[1], k_pool.shape[1], k_pool.shape[2], dh,
-            n_q_heads=Hq, itemsize=k_pool.dtype.itemsize, method=method)
+            B, block_tables.shape[1], k_pool.shape[1], Hkv, dh,
+            n_q_heads=Hq, itemsize=k_pool.dtype.itemsize, method=method,
+            L=L, q_tile=q_tile)
         _ledger.record_traced(
             "paged_attn", axis="local", world=1, nbytes=nbytes,
             method=method, est_s=nbytes / pm.detect_hardware().hbm_bw)
 
     if fused:
         from triton_distributed_tpu.kernels.paged_attention import (
-            paged_decode_attention,
+            paged_attention,
         )
 
-        out = paged_decode_attention(
-            q.reshape(B, Hq, dh), k_pool, v_pool, block_tables,
-            jnp.asarray(offset, jnp.int32) + 1, slot_mask=slot_mask,
-            scale=scale, interpret=interpret)
-        return out.reshape(B, 1, Hq, dh)
+        off = jnp.broadcast_to(
+            jnp.asarray(offset, jnp.int32).reshape(-1), (B,))
+        if seq_lens is None:
+            q_lens = jnp.full((B,), L, jnp.int32)
+        else:
+            q_lens = jnp.broadcast_to(
+                jnp.asarray(seq_lens, jnp.int32).reshape(-1), (B,))
+        return paged_attention(
+            q, k_pool, v_pool, block_tables, off + q_lens, q_lens=q_lens,
+            slot_mask=slot_mask, scale=scale, interpret=interpret)
 
     from triton_distributed_tpu.kernels.sp_attention import paged_gather_kv
 

@@ -149,13 +149,14 @@ class TPAttn:
           (B,) per-slot depth vector, and ``slot_mask`` (B,) drops dead
           slots' cache writes. New K/V scatter into the pool; attention
           reads back through ``nn.paged_attn_with_cache``, which routes
-          decode steps to the fused Pallas block-walk kernel
-          (``paged_attn="fused"``, the default — one pool pass, no
-          materialized view; NOTE it wins over ``use_flash_decode=False``,
-          so the xla golden mode exercises the same fused kernel) and
-          mixed/prefill steps (or ``paged_attn="gather"``) to the
-          paged_gather_kv fallback — either way arriving/finishing
-          sequences are pure DATA changes and the step never retraces.
+          EVERY step shape — decode, chunked prefill, ragged mixed — to
+          the fused Pallas block-walk kernel (``paged_attn="fused"``,
+          the default — one pool pass, no materialized view; NOTE it
+          wins over ``use_flash_decode=False``, so the xla golden mode
+          exercises the same fused kernel). ``paged_attn="gather"`` is
+          the explicit paged_gather_kv escape hatch / test oracle —
+          either way arriving/finishing sequences are pure DATA changes
+          and the step never retraces.
         """
         B, L, _ = qkv.shape
         qs, kvs = self.sizes(world)

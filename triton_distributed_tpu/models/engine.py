@@ -81,10 +81,10 @@ class Engine:
         block-paged pool (same spec — kv-heads at index 3 either way) and
         the call takes extra replicated data operands
         (offsets, block_tables, slot_mask[, seq_lens]) so slot churn never
-        changes a shape. ``paged_attn`` selects the paged decode read path
-        (fused block-walk kernel vs gather fallback — see
-        ``nn.paged_attn_with_cache``); it is baked into the trace, so a
-        BatchEngine picks it once at construction."""
+        changes a shape. ``paged_attn`` selects the paged KV read path for
+        every step shape (fused block-walk kernel vs the gather escape
+        hatch — see ``nn.paged_attn_with_cache``); it is baked into the
+        trace, so a BatchEngine picks it once at construction."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
         out_specs = ((P(), kspec, vspec, P()) if moe_stats

@@ -290,9 +290,10 @@ class Qwen3:
                        switch the caches to the block-paged pool layout
                        (n_layers, n_blocks, block_size, local_kv_heads, dh)
                        — see ``TPAttn._qkv_to_attn``.
-          paged_attn   "fused" (default) routes paged decode through the
-                       fused block-walk kernel; "gather" pins the
-                       materialized-view fallback (nn.paged_attn_with_cache).
+          paged_attn   "fused" (default) routes every paged step shape
+                       through the fused block-walk kernel; "gather" pins
+                       the materialized-view escape hatch / test oracle
+                       (nn.paged_attn_with_cache).
 
         ``return_moe_stats=True`` (MoE + mode='dist' only) appends a 4th
         output: ``{"n_dropped_dispatch", "n_dropped_expert"}`` int32 totals

@@ -268,26 +268,47 @@ def wire_bytes_all_to_all(per_dev_nbytes: int, world: int) -> int:
 
 def paged_attn_bytes(B: int, max_blocks: int, block_size: int,
                      n_kv_heads: int, head_dim: int, *, n_q_heads: int,
-                     itemsize: int = 2, method: str = "fused") -> int:
-    """HBM bytes one decode-attention step moves reading a block-paged KV
+                     itemsize: int = 2, method: str = "fused", L: int = 1,
+                     q_tile: int | None = None) -> int:
+    """HBM bytes one paged-attention step moves reading a block-paged KV
     pool (per layer, per device shard, worst case: every table full).
 
-    ``fused`` (kernels/paged_attention.py): q read + f32 out write + ONE
-    pass over the K and V pool bytes — the kernel DMAs blocks straight into
-    VMEM, no intermediate view. ``gather`` (sp_attention.paged_gather_kv +
-    dense/flash attention): the same pool bytes are read to build the
-    contiguous (B, max_blocks*block_size, Hkv, dh) view, written into it,
-    and read again by the attention kernel — 3x the KV bill. The comm
-    ledger records this next to the achieved wall time, so the fused-vs-
-    gather ratio in bench.py's ``paged_attn`` arm is this exact arithmetic.
+    ``fused`` / ``fused_decode`` / ``fused_prefill``
+    (kernels/paged_attention.py): q read + f32 out write + the kernel's
+    per-query-tile causal pass over the pool bytes — blocks DMA straight
+    into VMEM, no intermediate view, and each query tile stops at its own
+    causal frontier (block granular: whole ``block_size``-row blocks are
+    fetched). Decode (L = 1) and a single-tile prefill (``q_tile`` None or
+    >= L, the heuristic default) both read the pool exactly ONCE; a
+    smaller ``q_tile`` re-reads the shared prefix once per tile, and this
+    model bills that honestly — pass the q_tile the kernel actually runs
+    (``tuned_paged_tile``) so the ledger stays equal to the analytic
+    number. ``gather`` (sp_attention.paged_gather_kv + dense/flash
+    attention): the same pool bytes are read to build the contiguous
+    (B, max_blocks*block_size, Hkv, dh) view, written into it, and read
+    again by the attention kernel — 3x the KV bill regardless of L. The
+    comm ledger records this next to the achieved wall time, so the
+    fused-vs-gather ratio in bench.py's ``paged_attn`` arm is this exact
+    arithmetic.
     """
-    kv = 2 * B * max_blocks * block_size * n_kv_heads * head_dim * itemsize
-    q_out = B * n_q_heads * head_dim * (itemsize + 4)   # wire-dtype q, f32 out
-    if method == "fused":
-        return q_out + kv
+    S = max_blocks * block_size
+    kv_row = 2 * n_kv_heads * head_dim * itemsize         # K + V, one row
+    q_out = B * L * n_q_heads * head_dim * (itemsize + 4)  # wire q, f32 out
+    if method in ("fused", "fused_decode", "fused_prefill"):
+        qt = L if q_tile is None else max(1, min(int(q_tile), L))
+        n_q_tiles = -(-L // qt)
+        rows = 0
+        for i in range(n_q_tiles):
+            jmax_p1 = min((i + 1) * qt, L)
+            limit = min(S, S - L + jmax_p1)        # worst case: kv_len == S
+            rows += min(max_blocks,
+                        -(-max(0, limit) // block_size)) * block_size
+        return q_out + B * rows * kv_row
     if method == "gather":
-        return q_out + 3 * kv
-    raise ValueError(f"method must be 'fused' or 'gather', got {method!r}")
+        return q_out + 3 * B * S * kv_row
+    raise ValueError(
+        f"method must be 'fused', 'fused_decode', 'fused_prefill' or "
+        f"'gather', got {method!r}")
 
 
 def est_matmul(m: int, k: int, n: int, itemsize: int = 2,

@@ -115,9 +115,10 @@ class BatchEngine:
                    ALWAYS compiled into the steps (SPMD safety — see
                    module docstring); this flag only enables the host-side
                    check of it.
-    ``paged_attn`` "fused" (default): decode attention walks the block
-                   table inside the Pallas kernel — one pass over the pool
-                   bytes. "gather": the materialized-view reference path
+    ``paged_attn`` "fused" (default): every step shape — decode, chunked
+                   prefill, ragged mixed — walks the block table inside
+                   the Pallas kernel, one pass over the pool bytes.
+                   "gather": the materialized-view reference path
                    (``paged_gather_kv``), the escape hatch the fused kernel
                    is verified token-identical against. Baked into the
                    compiled steps at construction.
