@@ -965,6 +965,124 @@ def _bench_serve_journey() -> dict:
     }
 
 
+def _bench_serve_efficiency() -> dict:
+    """The ``--serve --efficiency`` arm: cost and accounting sanity of the
+    always-on efficiency ledger (obs/efficiency.py) vs the same engine
+    with the ledger off — the same two-engine interleaved-rounds protocol
+    as the journey arm, so drift cancels:
+
+        efficiency_overhead_frac = (t_on - t_off) / t_off
+
+    gated at ≤5% on real hardware, recorded-not-gated off-TPU. Asserted
+    everywhere: greedy output bit-identical with the ledger on, zero
+    retraces (the ledger is pure host arithmetic; ``trace_counts`` stays
+    {1,1}), every retained step's attribution fractions telescope to
+    1 ± 1e-6, MFU is nonzero, and the per-tenant cost table bills every
+    submitted tenant."""
+    import time as _time
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.efficiency import FRAC_TOL
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    kw = dict(n_slots=4, n_blocks=48, block_size=16, prefill_chunk=32)
+    be_on = BatchEngine(engine, **kw)          # ledger on (the default)
+    be_off = BatchEngine(engine, **kw, efficiency=False)
+
+    rng = np.random.default_rng(0)
+    n_req, gen = 16, 8
+    tenants = ("acme", "beta")
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(24, 49))).tolist()
+               for _ in range(n_req)]
+
+    def run_pass(be, tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}",
+                          tenant=tenants[i % len(tenants)])
+                for i, p in enumerate(prompts)]
+        t0 = _time.perf_counter()
+        done = be.run(max_steps=5000)
+        dt = _time.perf_counter() - t0
+        return [done[r] for r in rids], dt
+
+    out_on, _ = run_pass(be_on, "warm-on")     # compiles off the clock
+    out_off, _ = run_pass(be_off, "warm-off")
+    if out_on != out_off:
+        raise RuntimeError("efficiency ledger changed greedy output")
+
+    rounds = 6 if on_tpu else 3
+    t_on, t_off = [], []
+    for r in range(rounds):                    # interleaved: drift cancels
+        _, dt = run_pass(be_off, f"r{r}-off")
+        t_off.append(dt)
+        _, dt = run_pass(be_on, f"r{r}-on")
+        t_on.append(dt)
+    s_off, s_on = min(t_off), min(t_on)
+    frac = (s_on - s_off) / s_off
+
+    for be, tag in ((be_on, "on"), (be_off, "off")):
+        retr = be.trace_counts["decode"] + be.trace_counts["prefill"] - 2
+        if retr:
+            raise RuntimeError(f"efficiency-{tag} engine retraced {retr}x")
+        be.pool.check_invariants()
+
+    led = be_on.efficiency
+    if not led.frac_sum_ok:
+        raise RuntimeError("per-step attribution broke the telescoping-"
+                           "to-1.0 contract")
+    bad = [a for a in led.recent if abs(a.frac_sum - 1.0) > FRAC_TOL]
+    if bad:
+        raise RuntimeError(f"{len(bad)} retained steps exceed the "
+                           f"frac-sum tolerance (first: step {bad[0].step})")
+    if led.lifetime_mfu() <= 0.0:
+        raise RuntimeError("lifetime MFU is zero after a full serving run")
+    billed = {r["tenant"] for r in led.tenant_table()}
+    if not set(tenants) <= billed:
+        raise RuntimeError(f"tenant cost table missed a submitted tenant: "
+                           f"billed {sorted(billed)}")
+    snap = be_on.stats_snapshot()              # exercised, must be JSON-able
+    json.dumps(snap, default=str)
+    ok = (frac <= 0.05) or not on_tpu
+    extras = {
+        "serve_efficiency_off_s": round(s_off, 6),
+        "serve_efficiency_on_s": round(s_on, 6),
+        "efficiency_overhead_ok": ok,
+        "efficiency_overhead_gated": on_tpu,
+        "serve_efficiency_bit_identical": True,
+        "serve_efficiency_retraces": 0,
+        "efficiency_frac_sum_ok": True,
+        "eff_steps": int(led.steps),
+        "mfu": round(led.lifetime_mfu(), 9),
+        "mbu": round(led.lifetime_mbu(), 9),
+        "bubble_frac": round(led.lifetime_bubble_frac(), 6),
+        "tenant_count": len(billed),
+    }
+    if not ok:
+        raise RuntimeError(
+            f"efficiency ledger overhead {frac:.1%} exceeds the 5% "
+            f"step-time budget (off={s_off:.4f}s on={s_on:.4f}s)")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "efficiency_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 # --- adaptive-control arm (--serve --adaptive) -----------------------------
 #
 # Deterministic virtual-time cost model: one BatchEngine step costs a fixed
@@ -1256,15 +1374,18 @@ def main():
     if "--serve" in sys.argv:
         # --serve --slo: always-on telemetry overhead arm; --serve
         # --journey: request-journey tracing overhead arm; --serve
+        # --efficiency: efficiency-ledger overhead + accounting arm;
         # --adaptive: the SLO-driven controller vs the static grid (all
         # deterministic virtual time, so CPU CI gates it); plain --serve:
-        # the prefix-cache arm. Same placement rationale for all four.
+        # the prefix-cache arm. Same placement rationale for all five.
         with_slo = "--slo" in sys.argv
         adaptive = "--adaptive" in sys.argv
         with_journey = "--journey" in sys.argv
+        with_efficiency = "--efficiency" in sys.argv
         metric = ("goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
                   else "journey_overhead_frac" if with_journey
+                  else "efficiency_overhead_frac" if with_efficiency
                   else "prefix_hit_rate")
         try:
             if adaptive:
@@ -1273,6 +1394,8 @@ def main():
                 result = _bench_serve_slo()
             elif with_journey:
                 result = _bench_serve_journey()
+            elif with_efficiency:
+                result = _bench_serve_efficiency()
             else:
                 result = _bench_serve_prefix()
         except Exception as e:  # noqa: BLE001
@@ -1288,6 +1411,7 @@ def main():
                        suite=("serve_adaptive" if adaptive
                               else "serve_slo" if with_slo
                               else "serve_journey" if with_journey
+                              else "serve_efficiency" if with_efficiency
                               else "serve_prefix"))
         return
 

@@ -51,13 +51,24 @@
 #                                           journey rows present in the
 #                                           merged Chrome trace — all
 #                                           hard-checked anywhere
-#   9. tools/explain_request.py --chaos  -> forensic CLI smoke: seeded
+#   9. python bench.py --serve --efficiency -> efficiency-ledger arm:
+#                                           ledger-on vs ledger-off serving
+#                                           wall time (<= 5% enforced where
+#                                           the arm gates, i.e. on TPU),
+#                                           per-step attribution fractions
+#                                           telescoping to 1 +/- 1e-6,
+#                                           bit-identity, 0 retraces, and
+#                                           every submitted tenant billed —
+#                                           all hard-checked anywhere;
+#                                           plus a fleet_efficiency.py
+#                                           report render over --demo
+#  10. tools/explain_request.py --chaos  -> forensic CLI smoke: seeded
 #                                           fleet chaos run, reconstruct
 #                                           one requeued request's hop
 #                                           chain (the tool exits nonzero
 #                                           if the attribution fractions
 #                                           break the sum-to-1 contract)
-#  10. tools/perf_gate.py --db ...       -> compare newest vs history,
+#  11. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -259,6 +270,51 @@ if ex.get("journey_overhead_gated"):
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_efficiency run $i/2" >&2
+  python bench.py --serve --efficiency --perfdb "$DB" \
+    > "$WORKDIR/serve_efficiency_out.$i.json"
+  python - "$WORKDIR/serve_efficiency_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+assert obj["value"] is not None, obj
+ex = obj.get("extras", {})
+# The acceptance bar (ISSUE 15): the always-on efficiency ledger must not
+# change the greedy output or retrace, every retained step's attribution
+# fractions must telescope to 1 +/- 1e-6, MFU must be nonzero, and every
+# submitted tenant must appear in the cost table. The <=5% overhead
+# budget binds wherever the arm gates (real hardware — on the CPU
+# interpreter the serving loop is Python dispatch, so the arm records the
+# fraction but marks it ungated).
+assert ex.get("serve_efficiency_bit_identical") is True, ex
+assert ex.get("serve_efficiency_retraces") == 0, ex
+assert ex.get("efficiency_frac_sum_ok") is True, ex
+assert ex.get("eff_steps", 0) > 0, ex
+assert ex.get("tenant_count", 0) >= 2, ex
+assert ex.get("bubble_frac", 1.0) < 1.0, ex
+assert ex.get("efficiency_overhead_ok") is True, ex
+if ex.get("efficiency_overhead_gated"):
+    assert obj["value"] <= 0.05, obj["value"]
+EOF
+done
+
+echo "perf_gate_smoke: fleet_efficiency report smoke" >&2
+# The efficiency-report CLI over its deterministic demo frame: rendered
+# byte-identically twice, exit 0 healthy, exit 1 when the bubble gate is
+# set below the demo's aggregate bubble_frac.
+python tools/fleet_efficiency.py --demo > "$WORKDIR/fleet_efficiency.1.md"
+python tools/fleet_efficiency.py --demo > "$WORKDIR/fleet_efficiency.2.md"
+cmp "$WORKDIR/fleet_efficiency.1.md" "$WORKDIR/fleet_efficiency.2.md"
+grep -q "Tenant cost ranking" "$WORKDIR/fleet_efficiency.1.md"
+if python tools/fleet_efficiency.py --demo --max-bubble-frac 0.05 \
+    > /dev/null 2>&1; then
+  echo "perf_gate_smoke: fleet_efficiency bubble gate failed to trip" >&2
+  exit 1
+fi
+
 echo "perf_gate_smoke: explain_request chaos smoke" >&2
 # The forensic CLI reconstructs a requeued request's full hop chain from
 # a seeded chaos run; it exits 1 itself if the fractions-sum-to-1
@@ -302,5 +358,9 @@ python tools/perf_gate.py --db "$DB" --suite serve_adaptive \
 echo "perf_gate_smoke: gating serve_journey suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_journey \
   --tolerance "$TOL" --report "$WORKDIR/serve_journey_report.md"
+
+echo "perf_gate_smoke: gating serve_efficiency suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_efficiency \
+  --tolerance "$TOL" --report "$WORKDIR/serve_efficiency_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

@@ -322,7 +322,7 @@ def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
-         stats_jsonl: str | None = None) -> dict:
+         efficiency: bool = False, stats_jsonl: str | None = None) -> dict:
     """Run the load, return the metrics dict. Raises RuntimeError on any
     retrace beyond the first compile of each step kind; with ``chaos``,
     also on any violation of the graceful-degradation contract.
@@ -330,7 +330,10 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
     perf flight recorder's run database (obs/perfdb.py) so
     ``tools/perf_gate.py`` can gate serving latency across PRs.
     ``slo`` attaches the stock serving SLO set (generous thresholds) and
-    reports its verdicts in the result; ``stats_jsonl`` streams live
+    reports its verdicts in the result; ``efficiency`` asserts the
+    always-on efficiency ledger's accounting after the drain (every step's
+    fractions telescoped to 1, MFU nonzero, bubble_frac < 1) and includes
+    its stats in the result; ``stats_jsonl`` streams live
     ``stats_snapshot()`` lines to that path (``tools/serve_top.py`` tails
     it)."""
     import contextlib
@@ -459,6 +462,27 @@ def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
             raise RuntimeError(
                 f"{kind} step retraced {n} times — slot churn must be "
                 "data, not shape")
+    if efficiency:
+        # The efficiency ledger is always on; this arm asserts its
+        # accounting contract held for a full synthetic-load run: every
+        # step's attribution telescoped to 1.0, the modeled compute
+        # fraction is nonzero (the ledger saw real work), and the host
+        # bubble never swallowed the whole wall clock.
+        eff = be.efficiency
+        if eff is None or not eff.steps:
+            raise RuntimeError("efficiency ledger recorded no steps")
+        if not eff.frac_sum_ok:
+            raise RuntimeError("efficiency ledger frac-sum violation — "
+                               "per-step attribution did not telescope "
+                               "to 1.0")
+        if eff.lifetime_mfu() <= 0.0:
+            raise RuntimeError("efficiency ledger reports zero MFU after "
+                               "a loaded run")
+        bubble = eff.lifetime_bubble_frac()
+        if not bubble < 1.0:
+            raise RuntimeError(f"bubble_frac {bubble} >= 1 — every "
+                               "accounted second was a host gap")
+        m["efficiency"] = eff.stats()
     if perfdb_path:
         from triton_distributed_tpu.obs.perfdb import PerfDB
 
@@ -496,6 +520,10 @@ if __name__ == "__main__":
     ap.add_argument("--slo", action="store_true",
                     help="attach the stock serving SLO set and report its "
                          "verdicts")
+    ap.add_argument("--efficiency", action="store_true",
+                    help="assert the always-on efficiency ledger's "
+                         "accounting (frac sums 1.0, nonzero MFU, "
+                         "bubble_frac < 1) and report its stats")
     ap.add_argument("--adaptive", action="store_true",
                     help="run the adaptive-control arm: overload burst "
                          "drives WARN, the controller actuates, recovery "
@@ -527,6 +555,7 @@ if __name__ == "__main__":
             metrics = main(args.duration, rate_hz=args.rate,
                            seed=args.seed, chaos=args.chaos,
                            perfdb_path=args.perfdb, slo=args.slo,
+                           efficiency=args.efficiency,
                            stats_jsonl=args.stats_jsonl)
     except RuntimeError as e:
         print(f"FAIL: {e}")

@@ -1,0 +1,294 @@
+#!/usr/bin/env python
+"""fleet_efficiency: markdown efficiency report over a stats snapshot.
+
+Consumes one ``Fleet.stats_snapshot()`` / ``BatchEngine.stats_snapshot()``
+frame (the JSON the engine's ``stream_stats`` feed appends, or a snapshot
+file) and renders the efficiency ledger's accounting as a markdown report:
+
+  waterfall   where every accounted second went — the per-bucket
+              compute/hbm/comm/stall/bubble split that telescopes to 100%.
+  replicas    per-replica MFU / MBU / bubble_frac next to the aggregate,
+              so a straggler replica is one table row, not a hunt.
+  tenants     the per-tenant cost ranking: tokens, metered FLOP-seconds
+              and HBM-seconds, and each tenant's share of fleet compute.
+  bubbles     the worst host-bubble steps, each correlated against
+              blackbox flight-recorder events whose monotonic ``t`` falls
+              inside the gap interval — "the 80 ms bubble at step 412 was
+              an admission backpressure burst" instead of a bare number.
+
+    python tools/fleet_efficiency.py --stats-jsonl /tmp/serve_stats.jsonl
+    python tools/fleet_efficiency.py --snapshot snap.json --blackbox bb.json
+    python tools/fleet_efficiency.py --demo
+
+Pure consumer (reads JSON, shares no process with the engine), and
+``render_report`` is a pure snapshot->str function — the determinism tests
+call it directly. Exit codes: 0 healthy; 1 the ledger's accounting
+contract failed (a frac-sum violation) or ``--max-bubble-frac`` was
+exceeded; 2 no efficiency data / unreadable input.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# Correlation slop around a bubble's [t0, t1] gap interval: blackbox
+# timestamps round to 1 us, and the event that CAUSED a gap (an admission,
+# a preemption) is often recorded just past its edge.
+_CORR_SLOP_S = 0.05
+BUCKETS = ("compute", "hbm", "comm", "stall", "bubble")
+
+
+def _pct(x) -> str:
+    return f"{100.0 * float(x):.1f}%"
+
+
+def _extract(snap: dict) -> dict | None:
+    """Normalize the two snapshot shapes into {aggregate, replicas,
+    tenants, worst_bubble}. Engine snapshots carry the flat ledger stats;
+    fleet snapshots the rolled-up block."""
+    eff = snap.get("efficiency")
+    if not eff:
+        return None
+    if "aggregate" in eff:
+        return eff
+    return {"aggregate": {k: eff.get(k) for k in
+                          ("steps", "tokens", "accounted_s", "mfu", "mbu",
+                           "bubble_frac", "fracs", "frac_sum_ok")},
+            "replicas": {},
+            "tenants": eff.get("tenants", []),
+            "worst_bubble": eff.get("worst_bubble", [])}
+
+
+def _blackbox_events(snap: dict, blackbox: dict | None) -> list[dict]:
+    """Events to correlate bubbles against: an explicit ``--blackbox``
+    dump wins; otherwise whatever the snapshot embeds (resilience
+    snapshots carry the full ring; stats snapshots only counters)."""
+    for src in (blackbox, snap.get("blackbox")):
+        if isinstance(src, dict) and isinstance(src.get("events"), list):
+            return src["events"]
+        if isinstance(src, list):
+            return src
+    return []
+
+
+def _correlate(row: dict, events: list[dict]) -> list[dict]:
+    t0 = float(row.get("t0", 0.0)) - _CORR_SLOP_S
+    t1 = float(row.get("t1", 0.0)) + _CORR_SLOP_S
+    return [e for e in events
+            if isinstance(e.get("t"), (int, float)) and t0 <= e["t"] <= t1]
+
+
+def render_report(snap: dict, *, blackbox: dict | None = None,
+                  top: int = 10) -> str:
+    """The markdown report (pure function; None-safe on missing blocks)."""
+    eff = _extract(snap)
+    if eff is None:
+        return "# Fleet efficiency\n\nNo efficiency data in snapshot.\n"
+    agg = eff.get("aggregate") or {}
+    lines = ["# Fleet efficiency", ""]
+    lines.append(
+        f"steps={agg.get('steps', 0)}  tokens={agg.get('tokens', 0)}  "
+        f"accounted={float(agg.get('accounted_s') or 0.0):.3f}s  "
+        f"**MFU {_pct(agg.get('mfu') or 0.0)}**  "
+        f"**MBU {_pct(agg.get('mbu') or 0.0)}**  "
+        f"bubble {_pct(agg.get('bubble_frac') or 0.0)}  "
+        f"frac_sum={'OK' if agg.get('frac_sum_ok', True) else 'VIOLATED'}")
+    lines.append("")
+
+    fracs = agg.get("fracs") or {}
+    if fracs:
+        lines.append("## Where the time went")
+        lines.append("")
+        lines.append("| bucket | share | |")
+        lines.append("|---|---|---|")
+        for b in BUCKETS:
+            f = float(fracs.get(b, 0.0))
+            bar = "#" * int(round(40 * min(1.0, max(0.0, f))))
+            lines.append(f"| {b} | {_pct(f)} | `{bar}` |")
+        lines.append("")
+
+    reps = eff.get("replicas") or {}
+    if reps:
+        lines.append("## Per replica")
+        lines.append("")
+        lines.append("| replica | steps | mfu | mbu | bubble | frac_sum |")
+        lines.append("|---|---|---|---|---|---|")
+        for idx in sorted(reps, key=str):
+            r = reps[idx]
+            lines.append(
+                f"| {idx} | {r.get('steps', 0)} | {_pct(r.get('mfu', 0))} "
+                f"| {_pct(r.get('mbu', 0))} "
+                f"| {_pct(r.get('bubble_frac', 0))} "
+                f"| {'OK' if r.get('frac_sum_ok', True) else 'VIOLATED'} |")
+        lines.append("")
+
+    tenants = eff.get("tenants") or []
+    if tenants:
+        lines.append("## Tenant cost ranking")
+        lines.append("")
+        lines.append("| tenant | tokens | flop_s | hbm_s | cost share |")
+        lines.append("|---|---|---|---|---|")
+        for r in tenants[:top]:
+            lines.append(
+                f"| {r.get('tenant', '?')} | {r.get('tokens', 0)} "
+                f"| {float(r.get('flop_s', 0.0)):.6f} "
+                f"| {float(r.get('hbm_s', 0.0)):.6f} "
+                f"| {_pct(r.get('cost_frac', 0.0))} |")
+        if len(tenants) > top:
+            lines.append(f"| … {len(tenants) - top} more | | | | |")
+        lines.append("")
+
+    worst = eff.get("worst_bubble") or []
+    if worst:
+        events = _blackbox_events(snap, blackbox)
+        lines.append("## Worst host bubbles")
+        lines.append("")
+        for row in worst[:top]:
+            where = (f" (replica {row['replica']})"
+                     if "replica" in row else "")
+            lines.append(
+                f"- step {row.get('step', '?')}{where}: "
+                f"{1e3 * float(row.get('bubble_s', 0.0)):.1f} ms gap "
+                f"of a {1e3 * float(row.get('interval_s', 0.0)):.1f} ms "
+                f"interval")
+            hits = _correlate(row, events)
+            for e in hits[:4]:
+                detail = {k: v for k, v in e.items()
+                          if k not in ("t", "wall", "seq", "kind")}
+                lines.append(f"    - `{e.get('kind', '?')}` @t={e.get('t')}"
+                             + (f" {detail}" if detail else ""))
+            if events and not hits:
+                lines.append("    - (no flight-recorder events inside "
+                             "the gap)")
+        lines.append("")
+    return "\n".join(lines).rstrip() + "\n"
+
+
+def _demo_snapshot() -> dict:
+    """Deterministic synthetic frame (no engine, no jax) — what the
+    report-determinism tests and ``--demo`` render."""
+    return {
+        "efficiency": {
+            "aggregate": {"steps": 840, "tokens": 3360,
+                          "accounted_s": 12.5, "mfu": 0.37, "mbu": 0.58,
+                          "bubble_frac": 0.11, "frac_sum_ok": True,
+                          "fracs": {"compute": 0.37, "hbm": 0.21,
+                                    "comm": 0.05, "stall": 0.26,
+                                    "bubble": 0.11}},
+            "replicas": {
+                "0": {"steps": 420, "mfu": 0.41, "mbu": 0.60,
+                      "bubble_frac": 0.07, "frac_sum_ok": True},
+                "1": {"steps": 420, "mfu": 0.33, "mbu": 0.56,
+                      "bubble_frac": 0.15, "frac_sum_ok": True},
+            },
+            "tenants": [
+                {"tenant": "acme", "tokens": 2400, "flop_s": 3.1,
+                 "hbm_s": 1.9, "cost_frac": 0.74},
+                {"tenant": "beta", "tokens": 960, "flop_s": 1.1,
+                 "hbm_s": 0.8, "cost_frac": 0.26},
+            ],
+            "worst_bubble": [
+                {"step": 412, "replica": "1", "bubble_s": 0.081,
+                 "interval_s": 0.093, "t0": 100.0, "t1": 100.081},
+                {"step": 13, "replica": "0", "bubble_s": 0.044,
+                 "interval_s": 0.056, "t0": 40.0, "t1": 40.044},
+            ],
+        },
+        "blackbox": {"events": [
+            {"t": 100.02, "kind": "backpressure", "waiting": 6,
+             "pool_free": 2},
+            {"t": 40.01, "kind": "schedule_admit", "admitted": 3,
+             "waiting": 0},
+            {"t": 7.0, "kind": "finish", "req": "req-2"},
+        ]},
+    }
+
+
+def _last_snapshot(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().strip().splitlines()
+    except OSError:
+        return None
+    for line in reversed(lines):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--snapshot", default=None,
+                     help="stats_snapshot / resilience_snapshot JSON file")
+    src.add_argument("--stats-jsonl", default=None,
+                     help="stream_stats feed (newest frame is reported)")
+    src.add_argument("--demo", action="store_true",
+                     help="render a synthetic frame (no engine)")
+    ap.add_argument("--blackbox", default=None,
+                    help="Blackbox.dump_json file to correlate bubbles "
+                         "against (overrides events in the snapshot)")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows per ranking table")
+    ap.add_argument("--max-bubble-frac", type=float, default=None,
+                    help="exit 1 when the aggregate bubble_frac exceeds "
+                         "this gate")
+    args = ap.parse_args(argv)
+
+    if args.demo:
+        snap = _demo_snapshot()
+    elif args.snapshot is not None:
+        try:
+            with open(args.snapshot, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(
+                f"fleet_efficiency: cannot read {args.snapshot}: {e}\n")
+            return 2
+    elif args.stats_jsonl is not None:
+        snap = _last_snapshot(args.stats_jsonl)
+        if snap is None:
+            sys.stderr.write(f"fleet_efficiency: no parseable frame in "
+                             f"{args.stats_jsonl}\n")
+            return 2
+    else:
+        ap.error("need --snapshot, --stats-jsonl, or --demo")
+
+    bb = None
+    if args.blackbox is not None:
+        try:
+            with open(args.blackbox, encoding="utf-8") as f:
+                bb = json.load(f)
+        except (OSError, ValueError) as e:
+            sys.stderr.write(
+                f"fleet_efficiency: cannot read {args.blackbox}: {e}\n")
+            return 2
+
+    eff = _extract(snap)
+    if eff is None:
+        sys.stderr.write("fleet_efficiency: snapshot carries no efficiency "
+                         "block (ledger disabled?)\n")
+        return 2
+    sys.stdout.write(render_report(snap, blackbox=bb, top=args.top))
+
+    agg = eff.get("aggregate") or {}
+    rc = 0
+    if not agg.get("frac_sum_ok", True):
+        sys.stderr.write("fleet_efficiency: FRAC-SUM VIOLATION — per-step "
+                         "attribution did not telescope to 1.0\n")
+        rc = 1
+    if (args.max_bubble_frac is not None
+            and float(agg.get("bubble_frac") or 0.0) > args.max_bubble_frac):
+        sys.stderr.write(f"fleet_efficiency: bubble_frac "
+                         f"{float(agg.get('bubble_frac') or 0.0):.4f} exceeds "
+                         f"gate {args.max_bubble_frac}\n")
+        rc = 1
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -144,6 +144,40 @@ def _journey_lines(jn: dict) -> list[str]:
     return lines
 
 
+def _efficiency_lines(eff: dict) -> list[str]:
+    """The efficiency pane (``stats_snapshot()['efficiency']``): headline
+    MFU / MBU / bubble with utilization bars, the per-bucket attribution
+    waterfall, and the top tenants by metered compute cost. Handles both
+    the engine shape (flat ledger stats) and the fleet shape (an
+    ``aggregate`` block with fleet-merged tenants)."""
+    head = eff.get("aggregate", eff)
+    mfu = float(head.get("mfu", 0.0))
+    mbu = float(head.get("mbu", 0.0))
+    bub = float(head.get("bubble_frac", 0.0))
+    ok = head.get("frac_sum_ok", True)
+    lines = [
+        f"  eff    mfu {_bar(mfu)} {100.0 * mfu:5.1f}%   "
+        f"mbu {_bar(mbu)} {100.0 * mbu:5.1f}%   "
+        f"bubble={100.0 * bub:.1f}%"
+        f"{'' if ok else '   *FRAC-SUM VIOLATION*'}",
+    ]
+    fracs = head.get("fracs", {})
+    if fracs:
+        lines.append("    where  " + "  ".join(
+            f"{b}={100.0 * float(fracs.get(b, 0.0)):.0f}%"
+            for b in ("compute", "hbm", "comm", "stall", "bubble")))
+    tenants = eff.get("tenants", ())
+    if tenants:
+        lines.append("    tenant            tokens     flop_s     cost%")
+        for r in tenants[:5]:
+            lines.append(
+                f"      {str(r.get('tenant', '?')):<14} "
+                f"{int(r.get('tokens', 0)):>9}  "
+                f"{float(r.get('flop_s', 0.0)):>9.4f}  "
+                f"{100.0 * float(r.get('cost_frac', 0.0)):>7.1f}")
+    return lines
+
+
 def render(snap: dict) -> str:
     """Render one ``BatchEngine.stats_snapshot()`` (or
     ``Fleet.stats_snapshot()``) dict as a text frame."""
@@ -192,6 +226,9 @@ def render(snap: dict) -> str:
     jn = snap.get("journey")
     if jn:
         lines.extend(_journey_lines(jn))
+    eff = snap.get("efficiency")
+    if eff:
+        lines.extend(_efficiency_lines(eff))
     drops = []
     bb = snap.get("blackbox")
     if bb:
@@ -269,6 +306,23 @@ def _demo_snapshot(i: int) -> dict:
                 {"req": "req-87", "total_s": 0.44, "dominant": "decode",
                  "frac": 0.8, "status": "ok", "requeues": 0,
                  "preempts": 1},
+            ]},
+        "efficiency": {
+            "steps": 200 * i, "tokens": 160 * i,
+            "mfu": 0.18 if slow else 0.41,
+            "mbu": 0.52 if slow else 0.63,
+            "bubble_frac": 0.34 if slow else 0.06,
+            "frac_sum_ok": True,
+            "fracs": {"compute": 0.18 if slow else 0.41,
+                      "hbm": 0.34 if slow else 0.35,
+                      "comm": 0.04,
+                      "stall": 0.10 if slow else 0.12,
+                      "bubble": 0.34 if slow else 0.06},
+            "tenants": [
+                {"tenant": "acme", "tokens": 120 * i,
+                 "flop_s": 0.9 * i, "cost_frac": 0.75},
+                {"tenant": "beta", "tokens": 40 * i,
+                 "flop_s": 0.3 * i, "cost_frac": 0.25},
             ]},
         "blackbox": {"len": 512, "recorded": 600 * i, "dropped":
                      max(0, 600 * i - 512)},

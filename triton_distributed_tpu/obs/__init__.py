@@ -58,6 +58,7 @@ permanently. Design note: docs/observability.md.
 
 from triton_distributed_tpu.obs import blackbox  # noqa: F401
 from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
+from triton_distributed_tpu.obs import efficiency  # noqa: F401
 from triton_distributed_tpu.obs import journey  # noqa: F401
 from triton_distributed_tpu.obs import perfdb  # noqa: F401
 from triton_distributed_tpu.obs import roofline  # noqa: F401
@@ -73,6 +74,10 @@ from triton_distributed_tpu.obs.journey import (  # noqa: F401
 from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
     CommLedger,
     LedgerEntry,
+)
+from triton_distributed_tpu.obs.efficiency import (  # noqa: F401
+    EfficiencyLedger,
+    StepAttribution,
 )
 from triton_distributed_tpu.obs.perfdb import (  # noqa: F401
     FingerprintMismatch,
@@ -105,12 +110,12 @@ from triton_distributed_tpu.obs.window import (  # noqa: F401
 )
 
 __all__ = [
-    "Blackbox", "CommLedger", "FingerprintMismatch", "Histogram",
-    "Journey", "JourneyContext", "JourneyRecorder", "LedgerEntry",
-    "Metrics", "Objective", "PerfDB", "RequestTrace",
+    "Blackbox", "CommLedger", "EfficiencyLedger", "FingerprintMismatch",
+    "Histogram", "Journey", "JourneyContext", "JourneyRecorder",
+    "LedgerEntry", "Metrics", "Objective", "PerfDB", "RequestTrace",
     "RooflineRecord", "RunRecord", "SLOEngine", "SpanRecord",
-    "TailSampler", "Tracer", "Verdict", "WindowRing", "WindowStats",
-    "blackbox", "comm_ledger", "default_serving_slo", "group_profile",
-    "journey", "merge_chrome_traces", "parse_prometheus", "perfdb",
-    "roofline", "slo", "trace", "window",
+    "StepAttribution", "TailSampler", "Tracer", "Verdict", "WindowRing",
+    "WindowStats", "blackbox", "comm_ledger", "default_serving_slo",
+    "efficiency", "group_profile", "journey", "merge_chrome_traces",
+    "parse_prometheus", "perfdb", "roofline", "slo", "trace", "window",
 ]

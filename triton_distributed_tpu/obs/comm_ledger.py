@@ -248,6 +248,15 @@ def snapshot() -> dict[str, dict]:
     return _LEDGER.snapshot()
 
 
+def wall_s_total() -> float:
+    """Total achieved collective wall seconds across every series — the
+    efficiency ledger diffs this around each serving step to bucket the
+    step's comm time. Cheap enough to call per step (one lock, one sum
+    over a handful of series)."""
+    with _LEDGER._lock:
+        return sum(e.wall_s_total for e in _LEDGER._entries.values())
+
+
 def record(collective: str, **kw) -> None:
     _LEDGER.record(collective, **kw)
 

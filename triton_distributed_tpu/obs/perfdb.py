@@ -244,11 +244,18 @@ _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
 _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            "warm_over_cold", "slo_breach",
                            "recovery_steps", "requeue", "breach_steps",
-                           "oscillation")
+                           # "bubble_frac" (efficiency ledger: host gap
+                           # between steps over accounted interval) would
+                           # otherwise read higher-better via the "_frac"
+                           # hint — a bigger bubble is strictly worse.
+                           "oscillation", "bubble")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
-                        "hit_rate")
+                        # mfu/mbu (efficiency ledger): fraction of the
+                        # hardware's compute / HBM peak sustained — higher
+                        # is the whole point.
+                        "hit_rate", "mfu", "mbu")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
 
 # Overhead fractions measure a cost RATIO bounded near zero, so the

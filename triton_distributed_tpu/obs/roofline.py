@@ -197,6 +197,13 @@ def summary(records: dict[str, RooflineRecord] | None = None) -> dict:
 
 # Ordered (first match wins): specific families before generic suffixes.
 _METRIC_CLASS_RULES: tuple[tuple[tuple[str, ...], str], ...] = (
+    # Efficiency-ledger metrics first: "mfu" is utilization OF the MXU
+    # (compute class), "mbu" of the HBM pipe, and "bubble" is host time
+    # between steps — a class of its own, since no device resource bounds
+    # it and the fix is always host-side (scheduler/controller/router).
+    (("mfu",), "compute"),
+    (("mbu",), "hbm"),
+    (("bubble",), "host"),
     (("hbm_frac", "flash_decode", "weight_stream", "traffic_floor",
       "moe_block", "staging_bound", "paged_attn"), "hbm"),
     (("a2a", "all_to_all", "ar_loopback", "ar_machinery", "allreduce",

@@ -230,3 +230,16 @@ class WindowRing:
         counter rings, value-mass/s for histogram rings."""
         window_s = min(float(window_s), self.max_window_s)
         return self.query(window_s, now).sum / window_s if window_s else 0.0
+
+    def sum(self, window_s: float, now: float | None = None) -> float:
+        """Exact sum of observations over the trailing window. The
+        efficiency ledger's windowed MFU/MBU divide two of these (FLOPs
+        over accounted seconds), so they must come from the same merge —
+        this is just ``query().sum`` without forcing callers through the
+        full stats object."""
+        return self.query(window_s, now).sum
+
+    def mean(self, window_s: float, now: float | None = None) -> float:
+        """Exact mean of observations over the trailing window (0.0 when
+        the window is empty)."""
+        return self.query(window_s, now).mean

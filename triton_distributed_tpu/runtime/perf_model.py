@@ -319,3 +319,63 @@ def est_matmul(m: int, k: int, n: int, itemsize: int = 2,
     flops_t = 2 * m * k * n / (hw.peak_bf16_flops * mfu)
     bytes_t = (m * k + k * n + 2 * m * n) * itemsize / hw.hbm_bw
     return max(flops_t, bytes_t)
+
+
+# ---------------------------------------------------------------------------
+# Serving-step work models (obs/efficiency.py). One BatchEngine step is a
+# bag of (new_tokens, kv_len) rows — chunked-prefill rows consume many
+# token positions, decode rows exactly one — and these two functions turn
+# that bag into the modeled FLOPs / HBM bytes the efficiency ledger joins
+# against ``peak_bf16_tflops`` / ``hbm_gbps`` for live MFU / MBU.
+# ---------------------------------------------------------------------------
+
+
+def matmul_params(config) -> int:
+    """Weight-matrix parameters ACTIVE per token position: qkv/o
+    projections, the (SwiGLU gate+up+down) MLP — for MoE, only the
+    ``n_experts_per_tok`` routed experts a token actually visits — and the
+    LM head. Embedding lookups move no MXU FLOPs and are excluded."""
+    qkv = (config.d_model
+           * (config.n_heads + 2 * config.n_kv_heads) * config.head_dim)
+    proj = config.n_heads * config.head_dim * config.d_model
+    if config.n_experts:
+        d_ff = config.moe_d_ff or config.d_ff
+        mlp = 3 * config.d_model * d_ff * config.n_experts_per_tok
+    else:
+        mlp = 3 * config.d_model * config.d_ff
+    head = config.d_model * config.vocab_size
+    return config.n_layers * (qkv + proj + mlp) + head
+
+
+def step_flops(config, rows) -> float:
+    """Modeled forward FLOPs of one serving step. ``rows`` is an iterable
+    of ``(new_tokens, kv_len)`` per active slot: each computed token
+    position costs ``2 * matmul_params`` matmul FLOPs plus the causal
+    attention pass over its ``kv_len``-token context (QK^T and PV, each
+    ``2 * n_heads * head_dim * kv_len`` per layer)."""
+    mp = float(matmul_params(config))
+    attn = 4.0 * config.n_layers * config.n_heads * config.head_dim
+    total = 0.0
+    for q, kv in rows:
+        total += 2.0 * mp * q + attn * q * kv
+    return total
+
+
+def step_hbm_bytes(config, rows, *, block_size: int = 16,
+                   itemsize: int = 2, method: str = "fused",
+                   q_tile: int | None = None) -> float:
+    """Modeled HBM bytes of one serving step: the weight stream (every
+    active weight matrix read once per step — batched rows amortize it)
+    plus, per row and per layer, the block-paged KV pool traffic of
+    ``paged_attn_bytes`` over the blocks the row's ``kv_len`` context
+    occupies. Same byte model the comm ledger and the ``--paged-attn``
+    bench arm gate against, so the efficiency ledger's MBU and the kernel
+    byte-ratio gates can never disagree on what a step should move."""
+    total = float(matmul_params(config)) * itemsize
+    for q, kv in rows:
+        blocks = max(1, -(-int(kv) // block_size))
+        total += config.n_layers * paged_attn_bytes(
+            1, blocks, block_size, config.n_kv_heads, config.head_dim,
+            n_q_heads=config.n_heads, itemsize=itemsize, method=method,
+            L=max(1, int(q)), q_tile=q_tile)
+    return total

@@ -54,8 +54,10 @@ import numpy as np
 
 from triton_distributed_tpu.models.engine import Engine
 from triton_distributed_tpu.models.sampling import finite_logits_mask, sample_token
+from triton_distributed_tpu.obs import comm_ledger as _comm
 from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.obs.blackbox import Blackbox
+from triton_distributed_tpu.obs.efficiency import EfficiencyLedger
 from triton_distributed_tpu.obs.journey import JourneyRecorder
 from triton_distributed_tpu.obs.slo import (
     BREACH,
@@ -66,6 +68,7 @@ from triton_distributed_tpu.obs.slo import (
 from triton_distributed_tpu.obs.trace import TailSampler
 from triton_distributed_tpu.resilience import faults as _faults
 from triton_distributed_tpu.resilience import guards as _guards
+from triton_distributed_tpu.runtime import perf_model as _pm
 from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
 from triton_distributed_tpu.serving.prefix_cache import RadixPrefixCache
@@ -158,7 +161,8 @@ class BatchEngine:
                  prefix_cache: bool = True, windowed_metrics: bool = True,
                  blackbox: bool | int = True,
                  tail_sampling: bool | TailSampler = True,
-                 journey: bool | JourneyRecorder = True):
+                 journey: bool | JourneyRecorder = True,
+                 efficiency: bool | EfficiencyLedger = True):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
                 f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
@@ -206,6 +210,25 @@ class BatchEngine:
             self.journey = journey
         else:
             self.journey = JourneyRecorder() if journey else None
+        # Efficiency ledger (obs/efficiency.py): decomposes every step's
+        # wall interval into compute/hbm/comm/stall/bubble fractions and
+        # meters per-tenant cost. Pure host-side arithmetic on counters the
+        # step already produces — it never touches compiled state, so the
+        # bench --serve --efficiency arm can gate bit-identical outputs and
+        # trace_counts {1,1} with the ledger on.
+        if isinstance(efficiency, EfficiencyLedger):
+            self.efficiency = efficiency
+        elif efficiency:
+            self.efficiency = EfficiencyLedger()
+        else:
+            self.efficiency = None
+        # KV dtype width feeding step_hbm_bytes (tiny test configs run
+        # f32; real configs bf16).
+        self._eff_itemsize = int(jnp.dtype(engine.config.dtype).itemsize)
+        # Optional zero-arg callable returning a kprobe ``stall_summary``
+        # dict; when probes are wired it refines the ledger's stall bucket
+        # into dma_wait / sem_spin detail (never reclassifies).
+        self.eff_stall_source = None
         self._slo = None
         self._slo_eval_interval_s = 1.0
         self._slo_next_eval = 0.0
@@ -466,6 +489,8 @@ class BatchEngine:
             snap["sampler"] = self.sampler.stats()
         if self.journey is not None:
             snap["journey"] = self.journey.stats()
+        if self.efficiency is not None:
+            snap["efficiency"] = self.efficiency.stats()
         return snap
 
     def resilience_snapshot(self) -> dict:
@@ -508,6 +533,8 @@ class BatchEngine:
                                      list(self.sampler.kept)[-8:]]
         if self.journey is not None:
             out["journey"] = self.journey.dump()
+        if self.efficiency is not None:
+            out["efficiency"] = self.efficiency.dump()
         return out
 
     def perfdb_sample(self) -> dict:
@@ -535,6 +562,8 @@ class BatchEngine:
             out.update(self.journey.perfdb_sample())
         if self._controller is not None:
             out.update(self._controller.perfdb_sample())
+        if self.efficiency is not None and self.efficiency.steps:
+            out.update(self.efficiency.perfdb_sample())
         # Pool fragmentation (KVPool.fragmentation): lets block-size sweeps
         # in the run DB separate allocator shredding from kernel effects.
         frag = self.pool.fragmentation()
@@ -675,8 +704,10 @@ class BatchEngine:
     # -- request lifecycle --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
-               req_id=None) -> object:
-        """Queue one request; returns its id (used as the pool seq id)."""
+               req_id=None, tenant: str | None = None) -> object:
+        """Queue one request; returns its id (used as the pool seq id).
+        ``tenant`` is the billing identity for the efficiency ledger's
+        per-tenant cost table (untagged requests bill to "default")."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt or max_new_tokens < 1:
             raise ValueError("need a non-empty prompt and max_new_tokens>=1")
@@ -692,7 +723,7 @@ class BatchEngine:
         self._req_counter += 1
         req = Request(req_id=req_id, prompt=prompt,
                       max_new_tokens=max_new_tokens, priority=priority,
-                      submit_t=time.monotonic())
+                      submit_t=time.monotonic(), tenant=tenant)
         self.scheduler.submit(req)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
                            max_new_tokens=max_new_tokens)
@@ -702,8 +733,9 @@ class BatchEngine:
         if self.journey is not None:
             # Direct engine submit: the opening wait is the scheduler
             # queue (a fleet submit opens in "route" instead — fleet.py).
-            req.journey = self.journey.begin(req_id, phase="queue",
-                                             prompt_len=len(prompt))
+            req.journey = self.journey.begin(
+                req_id, phase="queue", prompt_len=len(prompt),
+                **({"tenant": tenant} if tenant else {}))
         return req_id
 
     def adopt(self, req: Request) -> object:
@@ -1098,7 +1130,43 @@ class BatchEngine:
         for i in _guards.bad_rows(np.asarray(finite), active):
             self._quarantine(i, "non-finite logits (NaN/Inf guard)")
 
+    # -- efficiency-ledger hooks --------------------------------------------
+    # step_begin at the top of each run function and step_end immediately
+    # after the device sync: everything between one step's sync and the
+    # next step's dispatch — admission, gauge updates, SLO/controller
+    # ticks, token post-processing — lands in the inter-step gap the
+    # ledger accounts as HOST BUBBLE, which is exactly the ISSUE's
+    # definition of it.
+
+    def _eff_begin(self) -> float:
+        """Mark dispatch start; returns the comm-ledger wall baseline the
+        matching ``_eff_end`` diffs (0.0 when either ledger is off)."""
+        if self.efficiency is None:
+            return 0.0
+        self.efficiency.step_begin()
+        return _comm.wall_s_total() if _comm.enabled() else 0.0
+
+    def _eff_end(self, comm0: float, rows, tokens: int,
+                 tenants: dict) -> None:
+        """Account one completed step: model its FLOPs / HBM bytes from
+        the (new_tokens, kv_len) ``rows`` it actually computed, diff the
+        comm ledger, and bill ``tenants`` (tenant -> token positions)."""
+        if self.efficiency is None:
+            return
+        comm_s = ((_comm.wall_s_total() - comm0)
+                  if _comm.enabled() else 0.0)
+        cfg = self.engine.config
+        stall = self.eff_stall_source() if self.eff_stall_source else None
+        self.efficiency.step_end(
+            flops=_pm.step_flops(cfg, rows),
+            hbm_bytes=_pm.step_hbm_bytes(
+                cfg, rows, block_size=self.pool.block_size,
+                itemsize=self._eff_itemsize, method=self.paged_attn),
+            comm_s=comm_s, tokens=tokens, tenants=tenants,
+            stall_summary=stall)
+
     def _run_decode(self):
+        comm0 = self._eff_begin()
         tok = np.array([s.last_tok if s else 0 for s in self._slots],
                        np.int32)
         offsets, tables, mask = self._operands()
@@ -1113,6 +1181,15 @@ class BatchEngine:
                     offsets, tables, mask, corrupt, key))
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
+        if self.efficiency is not None:
+            rows, tenants = [], {}
+            for s in self._slots:
+                if s is None:
+                    continue
+                rows.append((1, s.offset + 1))
+                t = s.req.tenant or "default"
+                tenants[t] = tenants.get(t, 0) + 1
+            self._eff_end(comm0, rows, len(rows), tenants)
         self.metrics.inc("decode_steps")
         self.metrics.inc("decode_rows",
                          sum(s is not None for s in self._slots))
@@ -1127,6 +1204,7 @@ class BatchEngine:
                 self._finish(i)
 
     def _run_mixed(self):
+        comm0 = self._eff_begin()
         L = self.prefill_chunk
         ids = np.zeros((self.n_slots, L), np.int32)
         seq_lens = np.zeros((self.n_slots,), np.int32)
@@ -1166,6 +1244,18 @@ class BatchEngine:
                     key))
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
+        if self.efficiency is not None:
+            rows, tenants = [], {}
+            for i, s in enumerate(self._slots):
+                if s is None or not seq_lens[i]:
+                    continue
+                take = int(seq_lens[i])
+                # kv_len at this step's end: the row attends its whole
+                # context up to and including the tokens just written.
+                rows.append((take, s.offset + take))
+                t = s.req.tenant or "default"
+                tenants[t] = tenants.get(t, 0) + take
+            self._eff_end(comm0, rows, pre_toks + dec_rows, tenants)
         self.metrics.inc("prefill_steps")
         # Per-step work accounting (prompt tokens actually consumed vs
         # 1-token decode rows riding the mixed step) — what the adaptive

@@ -190,9 +190,17 @@ class Controller:
             else:
                 decode += 1
         backlog += eng.scheduler.backlog_tokens()
+        # Efficiency-ledger host-bubble fraction rides along OBSERVATIONALLY
+        # (it lands in last_obs / the action log's context, it does not yet
+        # drive a knob): a control law that widens batching when the bubble
+        # dominates has its sensor ready. Lifetime ratio = pure function of
+        # accumulated plant state — no clock read here.
+        eff = getattr(eng, "efficiency", None)
         return {"queue": len(eng.scheduler), "decode_rows": decode,
                 "prefill_rows": prefill, "backlog_tokens": backlog,
                 "free_frac": eng.pool.headroom_frac,
+                "bubble_frac": (round(eff.lifetime_bubble_frac(), 6)
+                                if eff is not None else 0.0),
                 "level": (eng.slo.worst_level()
                           if eng.slo is not None else 0)}
 
@@ -210,6 +218,7 @@ class Controller:
                    "free": 0, "blocks": 0}
             from triton_distributed_tpu.serving.fleet import DEAD, ROUTABLE
             dead = []
+            bubble_s = interval_s = 0.0
             for rep in self.fleet.replicas:
                 if rep.state == DEAD:
                     dead.append(rep.idx)
@@ -223,9 +232,18 @@ class Controller:
                 pool = rep.engine.pool
                 agg["free"] += pool.n_free + pool.n_reclaimable
                 agg["blocks"] += pool.n_blocks
+                eff = getattr(rep.engine, "efficiency", None)
+                if eff is not None:
+                    t = eff.totals()
+                    bubble_s += t["seconds"]["bubble"]
+                    interval_s += t["interval_s"]
             agg["free_frac"] = (agg["free"] / agg["blocks"]
                                 if agg["blocks"] else 1.0)
             agg.pop("free"), agg.pop("blocks")
+            # Fleet bubble = summed gap seconds over summed accounted
+            # seconds (ratios never average across replicas).
+            agg["bubble_frac"] = (round(bubble_s / interval_s, 6)
+                                  if interval_s > 0 else 0.0)
             agg["step"] = self.fleet.n_steps
             agg["dead"] = tuple(dead)
             return agg

@@ -228,9 +228,12 @@ class Fleet:
     # -- request intake -----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *, priority: int = 0,
-               req_id=None) -> object:
+               req_id=None, tenant: str | None = None) -> object:
         """Queue one request fleet-side; the router places it on the next
-        ``step()``. Returns the request id."""
+        ``step()``. Returns the request id. ``tenant`` is the billing
+        identity for the efficiency ledger's per-tenant cost table; it
+        rides ON the Request (like the journey context), so attribution
+        follows the request across drain and cross-replica requeue."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         if not prompt or max_new_tokens < 1:
             raise ValueError("need a non-empty prompt and max_new_tokens>=1")
@@ -255,7 +258,7 @@ class Fleet:
         req = Request(req_id=req_id, prompt=prompt,
                       max_new_tokens=max_new_tokens, priority=priority,
                       arrival_seq=next(self._arrival),
-                      submit_t=time.monotonic())
+                      submit_t=time.monotonic(), tenant=tenant)
         self._submitted[req_id] = req
         self._pending.append(req)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
@@ -263,8 +266,9 @@ class Fleet:
         if self.journey is not None:
             # Fleet submits open in the "route" bucket: the first wait is
             # for a placement decision, not a replica queue.
-            req.journey = self.journey.begin(req_id, phase="route",
-                                             prompt_len=len(prompt))
+            req.journey = self.journey.begin(
+                req_id, phase="route", prompt_len=len(prompt),
+                **({"tenant": tenant} if tenant else {}))
         return req_id
 
     # -- health machine -----------------------------------------------------
@@ -532,7 +536,8 @@ class Fleet:
             candidates = [(rep.idx, self._signals(rep, tokens))
                           for rep in routable]
             try:
-                decision = self.router.route(tokens, candidates)
+                decision = self.router.route(tokens, candidates,
+                                             tenant=req.tenant)
             except _faults.TransientFault as e:
                 # Faulted placement defers THIS request and everything
                 # behind it to the next step — degradation, not loss, and
@@ -554,7 +559,8 @@ class Fleet:
                             for k, v in decision.scores.items()},
                     breakdown={str(k): {c: round(v, 6)
                                         for c, v in comp.items()}
-                               for k, comp in decision.breakdown.items()})
+                               for k, comp in decision.breakdown.items()},
+                    **({"tenant": req.tenant} if req.tenant else {}))
             rep.engine.adopt(req)
             placed = True
             self.metrics.inc("requests_routed")
@@ -766,6 +772,36 @@ class Fleet:
                if self._controller is not None else {}),
             **({"journey": self.journey.stats()}
                if self.journey is not None else {}),
+            **({"efficiency": eff} if (eff := self._efficiency_block())
+               else {}),
+        }
+
+    def _efficiency_block(self) -> dict:
+        """Fleet-wide efficiency rollup: aggregate MFU/MBU/bubble from
+        summed per-replica ledger TOTALS (ratios never average), the
+        per-replica rows, the merged per-tenant cost table (conserved
+        across kill+requeue because billing happened where the work ran),
+        and every replica's worst-bubble steps tagged with its idx."""
+        from triton_distributed_tpu.obs.efficiency import EfficiencyLedger
+        ledgers = {rep.idx: rep.engine.efficiency for rep in self.replicas
+                   if getattr(rep.engine, "efficiency", None) is not None}
+        if not ledgers:
+            return {}
+        replicas = {}
+        worst = []
+        for idx, led in ledgers.items():
+            st = led.stats()
+            worst.extend({**row, "replica": idx}
+                         for row in st.pop("worst_bubble", []))
+            st.pop("tenants", None)     # merged fleet-wide below
+            replicas[idx] = st
+        worst.sort(key=lambda r: -r["bubble_s"])
+        return {
+            "aggregate": EfficiencyLedger.aggregate(ledgers.values()),
+            "replicas": replicas,
+            "tenants": EfficiencyLedger.merge_tenant_tables(
+                led.tenant_table() for led in ledgers.values()),
+            "worst_bubble": worst[:8],
         }
 
     def perfdb_sample(self) -> dict:
@@ -776,15 +812,30 @@ class Fleet:
         for rep in self.replicas:
             for k, v in rep.engine.perfdb_sample().items():
                 if (k.endswith("_ms") or k.startswith("pool_")
-                        or k.startswith("journey_")):
+                        or k.startswith("journey_")
+                        or k in ("mfu", "mbu", "bubble_frac")
+                        or k.startswith(("tenant_", "eff_"))):
                     # Latency/pool shape is per-replica; journey metrics
                     # come from ONE recorder shared by every replica, so
                     # summing would count the fleet N times (added once
-                    # below).
+                    # below). Efficiency RATIOS likewise never sum —
+                    # fleet-level mfu/mbu/bubble_frac are recomputed from
+                    # summed totals below; tenant tables merge there too.
                     continue
                 out[k] = out.get(k, 0.0) + float(v)
         if self.journey is not None:
             out.update(self.journey.perfdb_sample())
+        eff = self._efficiency_block()
+        if eff and eff["aggregate"].get("steps"):
+            agg = eff["aggregate"]
+            out["mfu"] = float(agg["mfu"])
+            out["mbu"] = float(agg["mbu"])
+            out["bubble_frac"] = float(agg["bubble_frac"])
+            out["eff_steps"] = float(agg["steps"])
+            out["tenant_count"] = float(len(eff["tenants"]))
+            for row in eff["tenants"]:
+                out[f"tenant_tokens{{tenant={row['tenant']}}}"] = float(
+                    row["tokens"])
         fm = self.metrics.as_dict()
         out["requests_failed"] = (out.get("requests_failed", 0.0)
                                   + fm.get("requests_failed", 0.0))

@@ -65,6 +65,11 @@ class RouteDecision:
     # ``tools/explain_request.py`` renders to answer *why* this replica
     # won over the runner-up.
     breakdown: dict = dataclasses.field(default_factory=dict)
+    # Billing identity of the routed request (efficiency ledger): rides on
+    # the decision so the fleet's route hop and any decision log carry the
+    # tenant without a second lookup. Never scored on — placement stays
+    # tenant-blind.
+    tenant: str | None = None
 
 
 class Router:
@@ -125,11 +130,14 @@ class Router:
     def score(self, sig: dict) -> float:
         return sum(self.score_components(sig).values())
 
-    def route(self, tokens, candidates) -> RouteDecision | None:
+    def route(self, tokens, candidates,
+              tenant: str | None = None) -> RouteDecision | None:
         """Place one request. ``candidates`` is a list of ``(key,
         signals)`` pairs for the ROUTABLE replicas (the fleet's health
         machine already filtered the quarantined/draining/dead ones).
-        Returns None when the candidate list is empty.
+        Returns None when the candidate list is empty. ``tenant`` is
+        carried onto the decision verbatim (cost attribution metadata —
+        it never influences scoring).
 
         Fault site ``router.route`` fires first — before any signal is
         read — so an injected fault defers the whole placement with no
@@ -156,4 +164,4 @@ class Router:
         self.n_routed += 1
         return RouteDecision(replica=best_key, score=scores[best_key],
                              signals=signals, scores=scores,
-                             breakdown=breakdown)
+                             breakdown=breakdown, tenant=tenant)

@@ -71,6 +71,11 @@ class Request:
     # request so hop numbering survives preemption, drain, and
     # cross-replica requeue — one id space per request across the fleet.
     journey: object | None = None
+    # Billing identity for the efficiency ledger's per-tenant cost table.
+    # Rides on the request (like ``journey``) so cost attribution follows
+    # the request across preemption, drain, and cross-replica requeue —
+    # the ledger bills the replica where the work actually ran.
+    tenant: str | None = None
 
     @property
     def remaining_new(self) -> int:
