@@ -1323,6 +1323,258 @@ def _bench_serve_adaptive() -> dict:
     }
 
 
+# --- speculative-decoding arm (--serve --spec) -----------------------------
+#
+# Same deterministic virtual-time cost model as the adaptive arm, plus a
+# per-draft-position verify term: a verify row is one decode row whose
+# consumed width grows by the proposal length, so each drafted position
+# adds a small fixed cost whether or not it is accepted. Acceptance is the
+# only way speculation pays — which is exactly the trade the adaptive
+# controller has to navigate.
+_SPEC_CV = 0.02             # per draft position riding a verify row
+_SPEC_BOUNDS = (60.0, 4.0)  # virtual (ttft, tbt) bounds, both classes
+_SPEC_HORIZON = 60.0
+
+
+def _spec_workload(rng, vocab: int) -> list:
+    """Two interleaved populations in virtual arrival time: ``rep``
+    requests the oracle drafter nails (full acceptance — speculation is
+    free tokens) and ``rnd`` requests whose drafts never match (full
+    rejection — every drafted position is pure verify waste). No static
+    k is right for both: k=0 forfeits the rep wins, k>0 bleeds on every
+    rnd step forever. The adaptive controller must grow on rep, collapse
+    to 0 on rnd, per request."""
+    work = []
+    for i in range(6):
+        work.append((4.0 * i, "rep", 8, 64))
+    for i in range(10):
+        work.append((2.0 * i, "rnd", 8, 48))
+    work.sort(key=lambda w: (w[0], w[1]))
+    return [(vt, cls, rng.integers(0, vocab, size=plen).tolist(), gen)
+            for vt, cls, plen, gen in work]
+
+
+def _bench_serve_spec() -> dict:
+    """The ``--serve --spec`` arm: acceptance-driven adaptive k
+    (serving/speculative.py) against every static draft width, scored in
+    deterministic virtual time.
+
+    A plain (non-speculative) pass over the workload first produces the
+    golden outputs; a scripted oracle drafter then proposes the golden
+    continuation for ``rep`` requests (full acceptance) and a corrupted
+    one for ``rnd`` requests (full rejection) — acceptance is an exact,
+    scripted property of the workload, so the gate cannot flake on how
+    often a tiny model happens to loop. Five speculative runs follow:
+    static k in {0, 2, 4} and two adaptive runs (the second is the replay
+    witness). Gates, all strict: every arm's output bit-identical to the
+    golden pass (speculation is lossless under greedy), zero retraces
+    (draft width is pure step-operand data), adaptive goodput-under-SLO
+    beats EVERY static k, modeled HBM bytes per emitted token visibly
+    lower than k=0 (the MBU uplift: same weight reads amortized over more
+    tokens per step), and the adaptive replay bit-identical."""
+    import collections
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import (
+        BatchEngine,
+        ScriptedDrafter,
+        SpecController,
+        Speculative,
+    )
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    work = _spec_workload(np.random.default_rng(0), config.vocab_size)
+    plens = {f"{cls}-{i}": len(prompt)
+             for i, (_, cls, prompt, _) in enumerate(work)}
+    gold: dict = {}              # "cls-i" -> golden generated tokens
+
+    def oracle(rid, hist, max_k):
+        key = rid.split(":", 1)[1]
+        pos = len(hist) - plens[key]         # tokens emitted so far
+        cont = gold[key][pos:pos + max_k]
+        if key.startswith("rnd"):
+            return [(t + 1) % config.vocab_size for t in cont]
+        return list(cont)
+
+    def run_trace(tag, spec):
+        be = BatchEngine(engine, n_slots=4, n_blocks=64, block_size=8,
+                         prefill_chunk=32, max_seq_len=128,
+                         prefix_cache=False, speculative=spec)
+        vt, nxt = 0.0, 0
+        vt_submit, vt_first, vt_finish = {}, {}, {}
+        cls_of, gen_of = {}, {}
+        recent = collections.deque(maxlen=4)
+        breach_steps = warn_steps = 0
+        prev = {"prefill_tokens": 0.0, "decode_rows": 0.0,
+                "spec_proposed_tokens": 0.0}
+        for step_i in range(6000):
+            while nxt < len(work) and work[nxt][0] <= vt:
+                _, cls, prompt, gen = work[nxt]
+                rid = be.submit(prompt, max_new_tokens=gen,
+                                req_id=f"{tag}:{cls}-{nxt}")
+                vt_submit[rid], cls_of[rid], gen_of[rid] = vt, cls, gen
+                nxt += 1
+            busy = be.step()
+            m = be.metrics.as_dict()
+            d = {k: m.get(k, 0.0) - prev[k] for k in prev}
+            prev = {k: m.get(k, 0.0) for k in prev}
+            cost = (_ADAPT_C0 + _ADAPT_CP * d["prefill_tokens"]
+                    + _ADAPT_CD * d["decode_rows"]
+                    + _SPEC_CV * d["spec_proposed_tokens"])
+            vt += cost
+            for s in be._slots:
+                if (s is not None and s.req.output
+                        and s.req.req_id not in vt_first):
+                    vt_first[s.req.req_id] = vt
+            for rid in be._finished:
+                if rid not in vt_finish:
+                    vt_finish[rid] = vt
+                    vt_first.setdefault(rid, vt)
+            if d["decode_rows"] > 0:
+                recent.append(cost)
+                avg = sum(recent) / len(recent)
+                if avg > _ADAPT_TBT_BREACH:
+                    breach_steps += 1
+                elif avg > _ADAPT_TBT_WARN:
+                    warn_steps += 1
+            if nxt >= len(work) and not busy and not len(be.scheduler):
+                break
+        else:
+            raise RuntimeError(f"spec trace [{tag}] never drained")
+        be.pool.check_invariants()
+        if be.failed:
+            raise RuntimeError(f"spec trace [{tag}] failed requests: "
+                               f"{sorted(be.failed)}")
+        retraces = sum(max(0, c - 1) for c in be.trace_counts.values())
+        if retraces or be.trace_counts.get("prefill", 0) != 1:
+            raise RuntimeError(f"spec trace [{tag}] retraced: "
+                               f"{be.trace_counts}")
+        outputs = {rid.split(":", 1)[1]: list(req.output)
+                   for rid, req in be.finished.items()}
+        met_tokens = total_tokens = met = 0
+        for rid, t_sub in vt_submit.items():
+            if rid not in vt_finish:
+                raise RuntimeError(f"[{tag}] {rid} never finished")
+            gen = gen_of[rid]
+            ttft = vt_first[rid] - t_sub
+            tbt = (vt_finish[rid] - vt_first[rid]) / max(gen - 1, 1)
+            total_tokens += gen
+            if ttft <= _SPEC_BOUNDS[0] and tbt <= _SPEC_BOUNDS[1]:
+                met += 1
+                met_tokens += gen
+        mm = be.metrics.as_dict()
+        eff = be.efficiency.totals()
+        ctl = be.spec.controller if be.spec is not None else None
+        return {"tag": tag, "outputs": outputs,
+                "goodput": round(met_tokens / max(vt, _SPEC_HORIZON), 4),
+                "vt": round(vt, 2), "met": met, "total": len(vt_submit),
+                "total_tokens": total_tokens,
+                "breach_steps": breach_steps, "warn_steps": warn_steps,
+                "steps": step_i + 1,
+                "proposed": int(mm.get("spec_proposed_tokens", 0)),
+                "accepted": int(mm.get("spec_accepted_tokens", 0)),
+                "rollback": int(mm.get("spec_rollback_tokens", 0)),
+                "hbm_bytes": float(eff["hbm_bytes"]),
+                "ctl_stats": ctl.stats() if ctl else {}}
+
+    golden = run_trace("gold", False)
+    for key, toks in golden["outputs"].items():
+        gold[key] = toks
+
+    def arm(k=None):
+        if k is None:
+            return Speculative(drafter=ScriptedDrafter(oracle),
+                               controller=SpecController())
+        return Speculative(drafter=ScriptedDrafter(oracle),
+                           controller=SpecController(k_init=k, k_max=8,
+                                                     adaptive=False))
+
+    statics = {k: run_trace(f"k{k}", arm(k)) for k in (0, 2, 4)}
+    adapt = run_trace("adaptive", arm())
+    replay = run_trace("adaptive", arm())
+
+    for tag, r in list(statics.items()) + [("adaptive", adapt)]:
+        if r["outputs"] != golden["outputs"]:
+            bad = sorted(key for key in golden["outputs"]
+                         if r["outputs"].get(key)
+                         != golden["outputs"][key])
+            raise RuntimeError(
+                f"spec arm [{tag}] output diverged from golden on "
+                f"{bad[:4]} — speculation must be lossless under greedy")
+    if (replay["outputs"] != adapt["outputs"]
+            or replay["goodput"] != adapt["goodput"]
+            or replay["ctl_stats"] != adapt["ctl_stats"]):
+        raise RuntimeError("adaptive-k replay diverged — the draft/verify/"
+                           "accept path is not deterministic")
+    if os.environ.get("TDT_SPEC_DEBUG", "0") == "1":
+        import sys as _sys
+        for r in list(statics.values()) + [adapt]:
+            print({k: v for k, v in r.items() if k != "outputs"},
+                  file=_sys.stderr)
+    worst = max(statics.values(), key=lambda r: r["goodput"])
+    if adapt["goodput"] <= worst["goodput"]:
+        raise RuntimeError(
+            f"adaptive k goodput {adapt['goodput']} does not beat best "
+            f"static k={worst['tag']} ({worst['goodput']})")
+    if adapt["accepted"] <= 0:
+        raise RuntimeError("adaptive arm accepted no draft tokens")
+    if statics[0]["proposed"] != 0:
+        raise RuntimeError("k=0 arm proposed draft tokens")
+    # The MBU story: speculation does not change what must be read per
+    # step (weights dominate at this scale) but emits more tokens per
+    # read — modeled HBM bytes per emitted token must visibly fall vs
+    # k=0. Emitted tokens are identical across arms (bit-identity), so
+    # the ratio is a pure bytes ratio.
+    mbu_uplift = statics[0]["hbm_bytes"] / max(adapt["hbm_bytes"], 1.0)
+    if mbu_uplift <= 1.05:
+        raise RuntimeError(
+            f"speculation did not reduce HBM bytes per token vs k=0 "
+            f"(uplift {mbu_uplift:.4f})")
+    ctl_stats = adapt["ctl_stats"]
+    if not (ctl_stats["grows"] and ctl_stats["shrinks"]):
+        raise RuntimeError(
+            f"adaptive controller never moved both directions on the "
+            f"two-population trace: {ctl_stats}")
+    extras = {
+        "spec_requests": adapt["total"],
+        "spec_slo_met": adapt["met"],
+        "spec_accept_rate": round(
+            adapt["accepted"] / max(adapt["proposed"], 1), 4),
+        "spec_proposed_tokens": adapt["proposed"],
+        "spec_accepted_tokens": adapt["accepted"],
+        "spec_rollback_tokens": adapt["rollback"],
+        "spec_k_grows": ctl_stats["grows"],
+        "spec_k_shrinks": ctl_stats["shrinks"],
+        "spec_k_reversals": ctl_stats["reversals"],
+        "spec_steps_adaptive": adapt["steps"],
+        "spec_steps_k0": statics[0]["steps"],
+        "breach_steps": adapt["breach_steps"],
+        "warn_steps": adapt["warn_steps"],
+        "mbu_uplift_vs_k0": round(mbu_uplift, 4),
+        "spec_retraces": 0,
+        "spec_bit_identical": True,
+        "spec_replay_identical": True,
+        "goodput_static_best": worst["goodput"],
+        "spec_win_frac": round(adapt["goodput"] / worst["goodput"], 4),
+    }
+    for k, r in statics.items():
+        extras[f"goodput_static_k{k}"] = r["goodput"]
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "spec_goodput_under_slo",
+        "value": adapt["goodput"],
+        "unit": "tok/vt",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -1382,13 +1634,17 @@ def main():
         adaptive = "--adaptive" in sys.argv
         with_journey = "--journey" in sys.argv
         with_efficiency = "--efficiency" in sys.argv
-        metric = ("goodput_under_slo" if adaptive
+        with_spec = "--spec" in sys.argv
+        metric = ("spec_goodput_under_slo" if with_spec
+                  else "goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
                   else "journey_overhead_frac" if with_journey
                   else "efficiency_overhead_frac" if with_efficiency
                   else "prefix_hit_rate")
         try:
-            if adaptive:
+            if with_spec:
+                result = _bench_serve_spec()
+            elif adaptive:
                 result = _bench_serve_adaptive()
             elif with_slo:
                 result = _bench_serve_slo()
@@ -1408,7 +1664,8 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path,
-                       suite=("serve_adaptive" if adaptive
+                       suite=("serve_spec" if with_spec
+                              else "serve_adaptive" if adaptive
                               else "serve_slo" if with_slo
                               else "serve_journey" if with_journey
                               else "serve_efficiency" if with_efficiency

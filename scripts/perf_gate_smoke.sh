@@ -62,13 +62,26 @@
 #                                           all hard-checked anywhere;
 #                                           plus a fleet_efficiency.py
 #                                           report render over --demo
-#  10. tools/explain_request.py --chaos  -> forensic CLI smoke: seeded
+#  10. python bench.py --serve --spec   -> speculative decoding arm:
+#                                           acceptance-driven adaptive k
+#                                           must beat every static draft
+#                                           width {0, 2, 4} on goodput-
+#                                           under-SLO over the scripted
+#                                           two-population trace
+#                                           (deterministic virtual-time
+#                                           cost model, runs anywhere),
+#                                           with bit-identical outputs,
+#                                           zero retraces, a bit-identical
+#                                           replay, and modeled HBM bytes
+#                                           per token visibly lower than
+#                                           k=0 (the MBU uplift)
+#  11. tools/explain_request.py --chaos  -> forensic CLI smoke: seeded
 #                                           fleet chaos run, reconstruct
 #                                           one requeued request's hop
 #                                           chain (the tool exits nonzero
 #                                           if the attribution fractions
 #                                           break the sum-to-1 contract)
-#  11. tools/perf_gate.py --db ...       -> compare newest vs history,
+#  12. tools/perf_gate.py --db ...       -> compare newest vs history,
 #                                           markdown report, gate verdict
 #
 # Each suite records TWICE so the second run has a baseline to gate
@@ -301,6 +314,37 @@ if ex.get("efficiency_overhead_gated"):
 EOF
 done
 
+for i in 1 2; do
+  echo "perf_gate_smoke: serve_spec run $i/2" >&2
+  python bench.py --serve --spec --perfdb "$DB" \
+    > "$WORKDIR/serve_spec_out.$i.json"
+  python - "$WORKDIR/serve_spec_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+assert obj["value"] is not None, obj
+ex = obj.get("extras", {})
+# The acceptance bar (ISSUE 16): adaptive k strictly beats EVERY static
+# draft width on goodput-under-SLO (the arm hard-errors if not —
+# spec_win_frac > 1 is the recorded witness), outputs bit-identical to
+# the non-speculative golden pass, zero retraces (draft width is pure
+# step-operand data), a bit-identical replay, draft tokens actually
+# accepted AND rolled back (both sides of the trade exercised), and
+# modeled HBM bytes per emitted token visibly below the k=0 arm.
+assert ex.get("spec_win_frac", 0.0) > 1.0, ex
+assert obj["value"] > ex.get("goodput_static_best", 0.0), ex
+assert ex.get("spec_bit_identical") is True, ex
+assert ex.get("spec_replay_identical") is True, ex
+assert ex.get("spec_retraces") == 0, ex
+assert ex.get("spec_accepted_tokens", 0) > 0, ex
+assert ex.get("spec_rollback_tokens", 0) > 0, ex
+assert 0.0 < ex.get("spec_accept_rate", 0.0) <= 1.0, ex
+assert ex.get("mbu_uplift_vs_k0", 0.0) > 1.05, ex
+EOF
+done
+
 echo "perf_gate_smoke: fleet_efficiency report smoke" >&2
 # The efficiency-report CLI over its deterministic demo frame: rendered
 # byte-identically twice, exit 0 healthy, exit 1 when the bubble gate is
@@ -362,5 +406,9 @@ python tools/perf_gate.py --db "$DB" --suite serve_journey \
 echo "perf_gate_smoke: gating serve_efficiency suite" >&2
 python tools/perf_gate.py --db "$DB" --suite serve_efficiency \
   --tolerance "$TOL" --report "$WORKDIR/serve_efficiency_report.md"
+
+echo "perf_gate_smoke: gating serve_spec suite" >&2
+python tools/perf_gate.py --db "$DB" --suite serve_spec \
+  --tolerance "$TOL" --report "$WORKDIR/serve_spec_report.md"
 
 echo "perf_gate_smoke: OK (reports in $WORKDIR)" >&2

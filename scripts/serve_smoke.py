@@ -19,6 +19,11 @@ DEGRADATION instead of full completion: the engine must finish the run
 completed or quarantined-with-error, at least one request of each kind
 must exist, and the pool must still drain clean.
 
+``--spec`` runs the speculative-decoding arm: the same deterministic
+workload through a speculative engine (n-gram drafter + fused verify +
+KV rollback) and a plain engine, asserting byte-identical outputs,
+nonzero accepted draft tokens, and zero retraces on either engine.
+
 ``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
 N replicas behind the cache/SLO-aware router. Plain run: everything
 completes, no replica leaves the ROUTABLE states, every replica's two
@@ -319,6 +324,110 @@ def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
     return result
 
 
+def main_spec(*, seed: int = 0, n_requests: int = 16, gen: int = 32,
+              perfdb_path: str | None = None,
+              stats_jsonl: str | None = None) -> dict:
+    """The ``--spec`` arm: speculative decoding end to end, asserted
+    LOSSLESS. The same deterministic workload (half repetitive prompts —
+    n-gram fuel — half random) runs through a speculative engine and a
+    plain engine sharing the model params; the run fails unless
+
+      * every request's output is byte-identical across the two engines
+        (the acceptance rule + KV rollback changed WHEN tokens were
+        verified, never WHICH tokens were emitted);
+      * the drafter actually landed accepted tokens (> 0) — the greedy
+        cycles the tiny model falls into are the structural guarantee,
+        so a zero here means the verify plumbing is broken, not the
+        workload unlucky;
+      * neither engine retraced either compiled step (draft width churn
+        is ``seq_lens`` data, not shape).
+    """
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny", max_length=128)
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    start = time.monotonic()
+
+    rng = np.random.default_rng(seed)
+    prompts = []
+    for i in range(n_requests):
+        if i % 2:
+            prompts.append([5, 6, 7, 5, 6, 7, 5, 6])
+        else:
+            prompts.append(rng.integers(
+                0, config.vocab_size,
+                size=int(rng.integers(4, 10))).tolist())
+
+    def run(speculative):
+        be = BatchEngine(engine, n_slots=4, n_blocks=96, block_size=4,
+                         prefill_chunk=8, speculative=speculative)
+        if speculative and stats_jsonl:
+            be.stream_stats(stats_jsonl, interval_s=0.5)
+        for i, p in enumerate(prompts):
+            be.submit(p, max_new_tokens=gen, req_id=i)
+        out = be.run(max_steps=20000)
+        be.pool.check_invariants()
+        for kind, n in be.trace_counts.items():
+            if n > 1:
+                raise RuntimeError(
+                    f"{'spec' if speculative else 'plain'} {kind} step "
+                    f"retraced {n} times — draft width must be data, "
+                    "not shape")
+        return be, out
+
+    be_spec, out_spec = run(True)
+    _, out_plain = run(False)
+
+    diverged = [i for i in range(n_requests)
+                if out_spec.get(i) != out_plain.get(i)]
+    if diverged:
+        raise RuntimeError(f"speculative outputs diverged from plain "
+                           f"decode for requests {diverged} — speculation "
+                           "must be lossless under greedy")
+    m = be_spec.metrics.as_dict()
+    accepted = int(m.get("spec_accepted_tokens", 0))
+    proposed = int(m.get("spec_proposed_tokens", 0))
+    if not proposed:
+        raise RuntimeError("drafter proposed nothing — the n-gram fuel "
+                           "prompts never produced a draft")
+    if not accepted:
+        raise RuntimeError("zero drafts accepted — verify/acceptance "
+                           "plumbing is broken (the repetitive workload "
+                           "structurally produces accepts)")
+
+    result = {
+        "requests_submitted": n_requests,
+        "requests_completed": int(m.get("requests_completed", 0)),
+        "tokens_generated": int(m.get("tokens_generated", 0)),
+        "wall_s": round(time.monotonic() - start, 3),
+        "spec_proposed_tokens": proposed,
+        "spec_accepted_tokens": accepted,
+        "spec_verify_rows": int(m.get("spec_verify_rows", 0)),
+        "spec_rollback_tokens": int(m.get("spec_rollback_tokens", 0)),
+        "divergent_requests": 0,
+        "spec": be_spec.stats_snapshot()["spec"],
+        "trace_count_decode": be_spec.trace_counts["decode"],
+        "trace_count_prefill": be_spec.trace_counts["prefill"],
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = be_spec.perfdb_sample()
+        if result["wall_s"]:
+            sample["serve_tokens_per_s"] = round(
+                result["tokens_generated"] / result["wall_s"], 2)
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_spec", metrics=sample,
+            meta={"seed": seed, "n_requests": n_requests, "gen": gen})
+        result["perfdb_run_id"] = rec.run_id
+    return result
+
+
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
@@ -528,12 +637,23 @@ if __name__ == "__main__":
                     help="run the adaptive-control arm: overload burst "
                          "drives WARN, the controller actuates, recovery "
                          "walks back to OK with zero BREACH")
+    ap.add_argument("--spec", action="store_true",
+                    help="run the speculative-decoding arm: same workload "
+                         "through spec and plain engines; assert zero "
+                         "output divergence, nonzero accepted drafts, "
+                         "zero retraces")
     ap.add_argument("--stats-jsonl", default=None,
                     help="stream live stats_snapshot() JSON lines here "
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.adaptive:
+        if args.spec:
+            if args.chaos or args.replicas > 1 or args.adaptive:
+                raise SystemExit("--spec is its own arm; run it without "
+                                 "--chaos/--replicas/--adaptive")
+            metrics = main_spec(seed=args.seed, perfdb_path=args.perfdb,
+                                stats_jsonl=args.stats_jsonl)
+        elif args.adaptive:
             if args.chaos or args.replicas > 1:
                 raise SystemExit("--adaptive is its own arm; run it "
                                  "without --chaos/--replicas")

@@ -141,6 +141,31 @@ def test_serve_smoke_adaptive(tmp_path):
     assert "journeys" in frame
 
 
+def test_serve_smoke_spec(tmp_path):
+    """The --spec contract (ISSUE 16): the same deterministic workload
+    through a speculative and a plain engine must produce byte-identical
+    outputs with a NONZERO number of accepted draft tokens and zero
+    retraces on either engine (main_spec raises on any violation); the
+    stats feed carries the spec block serve_top renders as its pane."""
+    feed = tmp_path / "spec_stats.jsonl"
+    m = _load().main_spec(seed=0, n_requests=8, gen=24,
+                          stats_jsonl=str(feed))
+    assert m["requests_completed"] == m["requests_submitted"] > 0
+    assert m["divergent_requests"] == 0
+    assert m["spec_accepted_tokens"] > 0
+    assert m["spec_proposed_tokens"] >= m["spec_accepted_tokens"]
+    assert m["spec"]["drafter"] == "ngram"
+    assert m["trace_count_decode"] <= 1
+    assert m["trace_count_prefill"] == 1
+
+    import json
+
+    lines = feed.read_text().strip().splitlines()
+    assert lines, "spec stats stream wrote nothing"
+    snap = json.loads(lines[-1])
+    assert "spec" in snap and "accept_rate" in snap["spec"]
+
+
 def test_serve_smoke_chaos():
     """The --chaos mode's graceful-degradation contract: the engine rides
     out injected transient errors and NaN-poisoned rows, finishing with

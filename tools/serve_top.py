@@ -178,6 +178,48 @@ def _efficiency_lines(eff: dict) -> list[str]:
     return lines
 
 
+def _spec_lines(spec: dict) -> list[str]:
+    """The speculative-decoding pane (``stats_snapshot()['spec']``):
+    drafter + live k range + acceptance, windowed acceptance quantiles
+    and accepted-token goodput for the engine shape; per-replica k and
+    acceptance rows for the fleet shape."""
+    if "replicas" in spec:     # fleet rollup: ratio from summed counts
+        rate = 100.0 * float(spec.get("accept_rate", 0.0))
+        lines = [
+            f"  spec   accept={rate:.1f}%  "
+            f"proposed={int(spec.get('proposed', 0))}  "
+            f"accepted={int(spec.get('accepted', 0))}",
+            "    rep  drafter   k(live)   cap  accept%  verify  flips",
+        ]
+        for idx in sorted(spec["replicas"]):
+            r = spec["replicas"][idx]
+            lines.append(
+                f"    {idx:>3}  {str(r.get('drafter', '?')):<8} "
+                f"{r.get('k_live_min', 0)}-{r.get('k_live_max', 0):<6} "
+                f"{r.get('k_cap', 0):>4} "
+                f"{100.0 * float(r.get('accept_rate', 0.0)):>7.1f} "
+                f"{r.get('verify_steps', 0):>7}  {r.get('reversals', 0):>5}")
+        return lines
+    rate = float(spec.get("accept_rate", 0.0))
+    lines = [
+        f"  spec   {str(spec.get('drafter', '?'))}  "
+        f"k={spec.get('k_live_min', 0)}-{spec.get('k_live_max', 0)}"
+        f"/cap {spec.get('k_cap', 0)}  "
+        f"accept {_bar(rate)} {100.0 * rate:5.1f}%  "
+        f"verify={int(spec.get('verify_steps', 0))}  "
+        f"+{int(spec.get('grows', 0))}/-{int(spec.get('shrinks', 0))} "
+        f"moves ({int(spec.get('reversals', 0))} flips)",
+    ]
+    w = spec.get("accept_10s")
+    if w:
+        lines.append(
+            f"    accept 10s  p50={100.0 * float(w.get('p50', 0.0)):.0f}% "
+            f"p90={100.0 * float(w.get('p90', 0.0)):.0f}% "
+            f"p99={100.0 * float(w.get('p99', 0.0)):.0f}%   "
+            f"accepted_tps={float(spec.get('accepted_tps_10s', 0.0)):.1f}")
+    return lines
+
+
 def render(snap: dict) -> str:
     """Render one ``BatchEngine.stats_snapshot()`` (or
     ``Fleet.stats_snapshot()``) dict as a text frame."""
@@ -223,6 +265,9 @@ def render(snap: dict) -> str:
             f"{name}={_SLO_MARK.get(st, st)}"
             for name, st in sorted(slo.get("states", {}).items()))
         lines.append(f"  slo  {states}  breaches={slo.get('breaches', 0)}")
+    spec = snap.get("spec")
+    if spec:
+        lines.extend(_spec_lines(spec))
     jn = snap.get("journey")
     if jn:
         lines.extend(_journey_lines(jn))
@@ -290,6 +335,19 @@ def _demo_snapshot(i: int) -> dict:
                 "from": 64, "to": 8,
                 "reason": "slo pressure: protect decode TBT",
                 "level": 1} if slow else None},
+        "spec": {
+            "drafter": "ngram", "k_init": 2,
+            "k_cap": 2 if slow else 8,
+            "k_live_min": 1 if slow else 2,
+            "k_live_max": 2 if slow else 5,
+            "tracked": 4 if slow else 2,
+            "proposed": 40 * i, "accepted": 12 * i if slow else 30 * i,
+            "accept_rate": 0.3 if slow else 0.75,
+            "verify_steps": 30 * i, "grows": i // 6, "shrinks": i // 9,
+            "reversals": i // 18,
+            "accept_10s": {"count": 90, "p50": 0.3 if slow else 0.8,
+                           "p90": 0.7 if slow else 1.0, "p99": 1.0},
+            "accepted_tps_10s": 9.0 if slow else 48.0},
         "journey": {
             "begun": 10 * i + 4, "finished": 10 * i, "in_flight": 4,
             "kept": min(10 * i, 32), "event_drops": 0,

@@ -70,7 +70,8 @@ class Engine:
     # -- compiled step ------------------------------------------------------
 
     def _make_sm(self, mode: str, *, moe_stats: bool = False,
-                 paged: str | None = None, paged_attn: str = "fused"):
+                 paged: str | None = None, paged_attn: str = "fused",
+                 spec_verify: bool = False):
         """The per-mode shard_map of the model forward — the ONE definition
         of the step sharding, shared by the per-step jit (``_step_fn``),
         the scanned loop (``_serve_scanned_fn``), and the drop-stats audit
@@ -84,11 +85,25 @@ class Engine:
         changes a shape. ``paged_attn`` selects the paged KV read path for
         every step shape (fused block-walk kernel vs the gather escape
         hatch — see ``nn.paged_attn_with_cache``); it is baked into the
-        trace, so a BatchEngine picks it once at construction."""
+        trace, so a BatchEngine picks it once at construction.
+
+        ``spec_verify=True`` (``paged='prefill'`` only) threads the
+        speculative batched-verify flag through to the model forward: the
+        step emits a second replicated ``greedy`` (B, L) int32 output —
+        the argmax continuation at every position — between the logits and
+        the donated pool arrays. Same shapes, same sharding, one extra
+        replicated output; a speculative BatchEngine bakes it into its one
+        mixed-step trace."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
-        out_specs = ((P(), kspec, vspec, P()) if moe_stats
-                     else (P(), kspec, vspec))
+        if spec_verify and paged != "prefill":
+            raise ValueError("spec_verify requires the paged='prefill' "
+                             "(varlen mixed step) variant")
+        if spec_verify:
+            out_specs = (P(), P(), kspec, vspec)
+        else:
+            out_specs = ((P(), kspec, vspec, P()) if moe_stats
+                         else (P(), kspec, vspec))
         if paged is None:
             fwd = functools.partial(model.forward_device, mode=mode,
                                     interpret=self.interpret,
@@ -109,7 +124,7 @@ class Engine:
                     params, ids, kp, vp, offsets, mode=mode,
                     interpret=self.interpret, block_tables=block_tables,
                     slot_mask=slot_mask, seq_lens=seq_lens,
-                    paged_attn=paged_attn)
+                    paged_attn=paged_attn, spec_verify=spec_verify)
             in_specs = (model.param_specs(), P(), kspec, vspec,
                         P(), P(), P(), P())
         else:

@@ -272,7 +272,7 @@ class Qwen3:
                        mode: str = "dist", interpret=None,
                        return_moe_stats: bool = False, seq_lens=None,
                        block_tables=None, slot_mask=None,
-                       paged_attn: str = "fused"):
+                       paged_attn: str = "fused", spec_verify: bool = False):
         """One forward step on this device.
 
         ids: (B, L) int32, replicated. k/v_cache: this device's shard
@@ -294,6 +294,17 @@ class Qwen3:
                        through the fused block-walk kernel; "gather" pins
                        the materialized-view escape hatch / test oracle
                        (nn.paged_attn_with_cache).
+
+        ``spec_verify=True`` (speculative decoding's batched verify;
+        requires ``seq_lens``) inserts a SECOND output after ``logits``:
+        ``greedy`` (B, L) int32 — the argmax next-token prediction at EVERY
+        position of every row, not just the last valid one. Host-side
+        longest-prefix acceptance compares draft token j+1 against
+        ``greedy[b, j]``; position ``m`` doubles as the bonus token. The
+        last-position ``logits`` path is untouched (same gather-then-dot
+        arithmetic), so sampling stays bit-identical to the non-verify
+        step; the argmax sweep is one extra (B*L, d) x (d, vocab) matmul
+        reduced to int32 on device — no logits tensor is shipped back.
 
         ``return_moe_stats=True`` (MoE + mode='dist' only) appends a 4th
         output: ``{"n_dropped_dispatch", "n_dropped_expert"}`` int32 totals
@@ -330,6 +341,12 @@ class Qwen3:
             raise ValueError("return_moe_stats requires an MoE config in "
                              "mode='dist' (drops only exist on the EP "
                              "dispatch path)")
+        if spec_verify and seq_lens is None:
+            raise ValueError("spec_verify requires seq_lens (the batched "
+                             "verify step is a varlen mixed step)")
+        if spec_verify and return_moe_stats:
+            raise ValueError("spec_verify and return_moe_stats outputs "
+                             "are mutually exclusive")
 
         # MoE dist mode: the heavy expert weights stay OUT of the scan's xs
         # (closed over, full stacked (L, E, ...)) and the body passes a
@@ -407,6 +424,23 @@ class Qwen3:
                 body, h, (scan_layers, k_cache, v_cache, layer_ids))
 
         h = nn.rms_norm(h, params["final_norm"], c.rms_eps)
+        lm_head = (params["embed"].T if c.tie_embeddings
+                   else params["lm_head"])
+        greedy = None
+        if spec_verify:
+            # Argmax prediction at EVERY position (draft-verify needs the
+            # model's continuation after each consumed draft token). The
+            # all-position matmul reduces to int32 on device; the
+            # last-position logits below still go through the exact same
+            # gather-then-dot path as the non-verify step.
+            flat = h.reshape(-1, h.shape[-1])
+            all_logits = jnp.dot(flat, lm_head,
+                                 preferred_element_type=jnp.float32)
+            greedy = (jnp.argmax(all_logits, axis=-1).astype(jnp.int32)
+                      .reshape(h.shape[0], L))
+            if mode in ("dist", "xla"):
+                greedy = jax.lax.all_gather(greedy, self.axis, axis=0,
+                                            tiled=True)
         if seq_lens is None:
             last = h[:, -1]                                    # (*, d)
         else:
@@ -419,10 +453,10 @@ class Qwen3:
             last = jnp.take_along_axis(h, idx[:, None, None], axis=1)[:, 0]
         if mode in ("dist", "xla"):
             last = jax.lax.all_gather(last, self.axis, axis=0, tiled=True)
-        lm_head = (params["embed"].T if c.tie_embeddings
-                   else params["lm_head"])
         # bf16 operands, fp32 accumulation — no materialized fp32 weight copy
         logits = jnp.dot(last, lm_head, preferred_element_type=jnp.float32)
+        if spec_verify:
+            return logits, greedy, new_k, new_v
         if return_moe_stats:
             return logits, new_k, new_v, moe_stats
         return logits, new_k, new_v

@@ -248,14 +248,19 @@ _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            # between steps over accounted interval) would
                            # otherwise read higher-better via the "_frac"
                            # hint — a bigger bubble is strictly worse.
-                           "oscillation", "bubble")
+                           # "reversal" (speculative-k direction flips:
+                           # the adaptive controller changing its mind)
+                           # is flap, same as knob oscillation.
+                           "oscillation", "bubble", "reversal")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
                         # mfu/mbu (efficiency ledger): fraction of the
                         # hardware's compute / HBM peak sustained — higher
-                        # is the whole point.
-                        "hit_rate", "mfu", "mbu")
+                        # is the whole point. accept_rate (speculative
+                        # decoding): fraction of drafted tokens the model
+                        # verified — more free tokens per step.
+                        "hit_rate", "mfu", "mbu", "accept_rate")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
 
 # Overhead fractions measure a cost RATIO bounded near zero, so the

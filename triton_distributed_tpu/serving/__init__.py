@@ -12,7 +12,9 @@ two fixed-shape compiled steps. See docs/serving.md for the design note.
   Fleet / Replica        — N replicas + health machine + drain/requeue
   Router / RouteDecision — cache-/SLO-/load-aware request placement
   Controller / Knob      — SLO-driven adaptive control plane (budget,
-                           backpressure, reclaim, shed, revive)
+                           backpressure, reclaim, shed, revive, spec k)
+  Drafter / SpecController — speculative decoding: host-side drafters,
+                           fused batched verify, KV rollback, adaptive k
   Metrics                — counters / gauges / histograms for the above
 """
 
@@ -37,9 +39,19 @@ from triton_distributed_tpu.serving.prefix_cache import (
 )
 from triton_distributed_tpu.serving.router import RouteDecision, Router
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
+from triton_distributed_tpu.serving.speculative import (
+    Drafter,
+    LearnedHeadDrafter,
+    NGramDrafter,
+    ScriptedDrafter,
+    SpecController,
+    Speculative,
+)
 
 __all__ = ["BatchEngine", "Controller", "DEAD", "DEGRADED", "DRAINING",
-           "Fleet", "HEALTHY", "Histogram", "KVPool", "Knob", "Metrics",
+           "Drafter", "Fleet", "HEALTHY", "Histogram", "KVPool", "Knob",
+           "LearnedHeadDrafter", "Metrics", "NGramDrafter",
            "PagedKVState", "PrefixMatch", "QUARANTINED", "RECOVERED",
            "ROUTABLE", "RadixPrefixCache", "Replica", "Request",
-           "RouteDecision", "Router", "Scheduler"]
+           "RouteDecision", "Router", "Scheduler", "ScriptedDrafter",
+           "SpecController", "Speculative"]

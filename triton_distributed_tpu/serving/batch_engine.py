@@ -73,6 +73,7 @@ from triton_distributed_tpu.serving.kv_pool import KVPool, PagedKVState
 from triton_distributed_tpu.serving.metrics import Metrics
 from triton_distributed_tpu.serving.prefix_cache import RadixPrefixCache
 from triton_distributed_tpu.serving.scheduler import Request, Scheduler
+from triton_distributed_tpu.serving.speculative import as_speculative
 
 # The trailing windows every stats snapshot reports ("last 10 s" for the
 # live dashboard's now-view, "last 5 min" for trends) over these series.
@@ -150,6 +151,19 @@ class BatchEngine:
                    fraction; pass a configured ``TailSampler`` or False.
     ``attach_slo()`` adds the OK/WARN/BREACH state machine on top; a
                    BREACH fires the attached watchdog's snapshot path.
+    ``speculative`` draft-then-verify decoding (serving/speculative.py):
+                   True = n-gram drafter + default adaptive-k controller,
+                   or pass a ``Drafter`` / a ``Speculative`` plan. The
+                   drafter proposes up to k tokens per decode slot, the
+                   ONE compiled mixed step verifies them as a ragged row
+                   (``q_lens = 1 + proposed`` — pure seq_lens data, zero
+                   retraces), host-side longest-prefix acceptance emits
+                   the accepted drafts plus the model's own bonus token,
+                   and ``KVPool.truncate`` rolls back the rejected
+                   suffix. Greedy output stays bit-identical to the
+                   non-speculative engine (the bonus token IS what
+                   one-at-a-time decode would have emitted), so
+                   speculation requires ``temperature == 0.0``.
     """
 
     def __init__(self, engine: Engine, *, n_slots: int = 8,
@@ -162,11 +176,19 @@ class BatchEngine:
                  blackbox: bool | int = True,
                  tail_sampling: bool | TailSampler = True,
                  journey: bool | JourneyRecorder = True,
-                 efficiency: bool | EfficiencyLedger = True):
+                 efficiency: bool | EfficiencyLedger = True,
+                 speculative=False):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
                 f"paged_attn must be 'fused' or 'gather', got {paged_attn!r}")
         self.paged_attn = paged_attn
+        self.spec = as_speculative(speculative)
+        if self.spec is not None and engine.temperature != 0.0:
+            raise ValueError(
+                "speculative decoding requires greedy sampling "
+                f"(temperature == 0.0, got {engine.temperature}): the "
+                "longest-prefix acceptance rule is only lossless under "
+                "argmax")
         self.engine = engine
         world = engine.mesh.shape[engine.model.axis]
         if engine.decode_mode in ("dist", "xla") and n_slots % world:
@@ -257,6 +279,9 @@ class BatchEngine:
         # normal step; a fault directive swaps in a row of NaN. One cached
         # device array, so the disabled path never re-uploads.
         self._corrupt0 = jnp.zeros((n_slots,), jnp.float32)
+        # Per-step draft proposals, slot index -> token list; rebuilt by
+        # ``step()`` every iteration (never carried across steps).
+        self._proposals: dict[int, list[int]] = {}
         self._build_steps()
 
     # -- compiled steps -----------------------------------------------------
@@ -264,10 +289,15 @@ class BatchEngine:
     def _build_steps(self):
         eng = self.engine
         V = eng.config.vocab_size
+        spec = self.spec is not None
         sm_dec = eng._make_sm(eng.decode_mode, paged="decode",
                               paged_attn=self.paged_attn)
+        # With speculation the ONE mixed step also emits the all-position
+        # argmax continuation (``greedy``) — baked into the single trace,
+        # so verify steps, chunked prefill, and plain mixed iterations all
+        # share it and trace_counts stays {1,1}.
         sm_pre = eng._make_sm(eng.prefill_mode, paged="prefill",
-                              paged_attn=self.paged_attn)
+                              paged_attn=self.paged_attn, spec_verify=spec)
         temperature, top_p = eng.temperature, eng.top_p
         trace_counts = self.trace_counts
 
@@ -298,12 +328,23 @@ class BatchEngine:
                        seq_lens, corrupt, key):
             trace_counts["prefill"] += 1
             ids = jnp.clip(ids, 0, V - 1)
-            logits, k, v = sm_pre(params, ids, k, v, offsets, block_tables,
-                                  slot_mask, seq_lens)
+            if spec:
+                logits, greedy, k, v = sm_pre(params, ids, k, v, offsets,
+                                              block_tables, slot_mask,
+                                              seq_lens)
+            else:
+                logits, k, v = sm_pre(params, ids, k, v, offsets,
+                                      block_tables, slot_mask, seq_lens)
             logits = logits + corrupt[:, None]
             finite = finite_logits_mask(logits)
             nxt = sample_token(logits, key, temperature=temperature,
                                top_p=top_p)
+            # NaN injected at the last position (``corrupt``) only poisons
+            # ``nxt``; a REAL non-finite at an interior verify position
+            # propagates through causal attention to the last position, so
+            # the row-level ``finite`` mask covers ``greedy`` too.
+            if spec:
+                return nxt, finite, greedy, k, v
             return nxt, finite, k, v
 
         self._decode_step = decode_step
@@ -491,6 +532,20 @@ class BatchEngine:
             snap["journey"] = self.journey.stats()
         if self.efficiency is not None:
             snap["efficiency"] = self.efficiency.stats()
+        if self.spec is not None:
+            blk = {"drafter": self.spec.name,
+                   **self.spec.controller.stats()}
+            if self.metrics.windowed:
+                # Windowed acceptance quality + accepted-token goodput
+                # (rides the PR 10 rings): what serve_top's spec pane and
+                # the SLO-side "is speculation still paying?" read want.
+                w = self.metrics.window("spec_accept_ratio", 10.0)
+                if w:
+                    blk["accept_10s"] = w
+                blk["accepted_tps_10s"] = round(
+                    self.metrics.window_counter("spec_accepted_tokens",
+                                                10.0) / 10.0, 3)
+            snap["spec"] = blk
         return snap
 
     def resilience_snapshot(self) -> dict:
@@ -558,6 +613,13 @@ class BatchEngine:
                 out[k] = float(m[k])
         out["retraces"] = max(0.0, float(self.trace_counts["decode"]
                                          + self.trace_counts["prefill"] - 2))
+        if self.spec is not None:
+            out.update(self.spec.controller.perfdb_sample())
+            for k in ("spec_proposed_tokens", "spec_accepted_tokens",
+                      "spec_verify_rows", "spec_rollback_tokens",
+                      "spec_rollback_blocks", "spec_drafts_dropped"):
+                if k in m:
+                    out[k] = float(m[k])
         if self.journey is not None:
             out.update(self.journey.perfdb_sample())
         if self._controller is not None:
@@ -788,6 +850,8 @@ class BatchEngine:
             self.pool.release(s.req.req_id)
             s.req.n_preemptions += 1
             self._slots[i] = None
+            if self.spec is not None:
+                self.spec.drafter.release(s.req.req_id)
             self.metrics.inc("preemptions")
             self.metrics.inc("drained_requests")
             _trace.instant("drain", req=s.req.req_id, slot=i,
@@ -894,6 +958,12 @@ class BatchEngine:
                                              admit_seq=self._admit_seq,
                                              ctx=ctx, offset=matched)
             self._admit_seq += 1
+            if self.spec is not None:
+                # Rebuild the drafter's tables from the REQUEST's token
+                # history — never from surviving drafter state — so a
+                # preempted/requeued/fleet-migrated request proposes
+                # exactly what it would have on its original timeline.
+                self.spec.drafter.adopt(req.req_id, ctx)
             self.metrics.inc("requests_admitted")
             if caching:
                 # Hit accounting lives HERE, not in the cache: only an
@@ -931,6 +1001,11 @@ class BatchEngine:
         s.req.n_preemptions += 1
         self.scheduler.requeue(s.req)
         self._slots[idx] = None
+        if self.spec is not None:
+            # Drop drafter tables (re-adoption rebuilds them from the
+            # request's history); the controller KEEPS its acceptance
+            # window — it still predicts the recompute replay.
+            self.spec.drafter.release(s.req.req_id)
         self.metrics.inc("preemptions")
         _trace.instant("preempt", req=s.req.req_id, slot=idx,
                        progress=s.offset)
@@ -990,6 +1065,9 @@ class BatchEngine:
         self.pool.release(s.req.req_id)
         self._slots[idx] = None
         self._finished[s.req.req_id] = s.req
+        if self.spec is not None:
+            self.spec.drafter.release(s.req.req_id)
+            self.spec.controller.forget(s.req.req_id)
         self.metrics.inc("requests_completed")
         e2e = s.req.finish_t - s.req.submit_t
         self.metrics.observe("e2e_latency_s", e2e)
@@ -1028,6 +1106,9 @@ class BatchEngine:
         self.pool.release(req.req_id)
         self._slots[idx] = None
         self._failed[req.req_id] = req
+        if self.spec is not None:
+            self.spec.drafter.release(req.req_id)
+            self.spec.controller.forget(req.req_id)
         self.metrics.inc("requests_failed")
         _trace.instant("quarantine", req=req.req_id, slot=idx,
                        reason=reason)
@@ -1046,6 +1127,8 @@ class BatchEngine:
     def _record_token(self, s: _Slot, tok: int):
         s.req.output.append(tok)
         s.last_tok = tok
+        if self.spec is not None:
+            self.spec.drafter.observe(s.req.req_id, tok)
         self.metrics.inc("tokens_generated")
         now = time.monotonic()
         gap = None
@@ -1071,18 +1154,61 @@ class BatchEngine:
                 and gap is not None and gap > self.sampler.slow_s):
             self.sampler.mark_slow(s.req.req_id, slow_gap_s=round(gap, 6))
 
+    # -- speculative drafting -----------------------------------------------
+
+    def _draft(self) -> dict[int, list[int]]:
+        """Ask the drafter for up to k tokens per DECODE slot (prefilling
+        slots have nothing to speculate on). The width cap per slot:
+          controller k   acceptance-adaptive, clamped by the serving
+                         controller's ``spec_k_cap`` SLO knob;
+          remaining-1    a verify step emits at most proposed+1 tokens,
+                         so never propose past the request's budget;
+          chunk-1        the mixed step's compiled ids width holds
+                         ``last_tok`` plus the proposals."""
+        ctl = self.spec.controller
+        drafter = self.spec.drafter
+        out: dict[int, list[int]] = {}
+        for i, s in enumerate(self._slots):
+            if s is None or s.prefilling:
+                continue
+            cap = min(ctl.k_for(s.req.req_id), s.req.remaining_new - 1,
+                      self.prefill_chunk - 1)
+            if cap <= 0:
+                continue
+            props = drafter.propose(s.req.req_id, cap)
+            if props:
+                out[i] = [int(t) for t in props[:cap]]
+        return out
+
     # -- iteration ----------------------------------------------------------
 
     def step(self) -> bool:
         """One scheduler iteration: admit, then run one compiled step.
         Returns False when there is nothing to do (idle)."""
         self._admit()
+        self._proposals = self._draft() if self.spec is not None else {}
         # Decode rows write one token this step — make room first (prefill
-        # rows were fully funded at admission).
+        # rows were fully funded at admission). A slot with draft
+        # proposals needs blocks for all of them up front; speculation
+        # NEVER preempts a neighbor for that — if the wider allocation
+        # doesn't fit, the proposal is dropped and the slot falls back to
+        # the plain one-token path.
         for i in range(self.n_slots):
             s = self._slots[i]
-            if s is not None and not s.prefilling:
-                self._ensure_or_preempt(i)
+            if s is None or s.prefilling:
+                continue
+            props = self._proposals.get(i)
+            if props:
+                try:
+                    ok = self._ensure_blocks(
+                        s.req.req_id, s.offset + 1 + len(props))
+                except _faults.TransientFault:
+                    ok = False
+                if ok:
+                    continue
+                del self._proposals[i]
+                self.metrics.inc("spec_drafts_dropped")
+            self._ensure_or_preempt(i)
         active = [i for i, s in enumerate(self._slots) if s is not None]
         self.metrics.set_gauge("queue_depth", len(self.scheduler))
         self.metrics.set_gauge("active_slots", len(active))
@@ -1099,8 +1225,14 @@ class BatchEngine:
             self._controller.on_step()
         if not active:
             return False
+        # Draft proposals ride the mixed step (ragged verify rows need
+        # seq_lens); a step with neither prefill rows nor proposals uses
+        # the cheaper (n_slots, 1) decode step unchanged.
+        self._proposals = {i: p for i, p in self._proposals.items()
+                           if self._slots[i] is not None}
         run = (self._run_mixed
-               if any(self._slots[i].prefilling for i in active)
+               if (any(self._slots[i].prefilling for i in active)
+                   or self._proposals)
                else self._run_decode)
         if self._watchdog is not None:
             with self._watchdog.deadline("serving_step",
@@ -1206,6 +1338,7 @@ class BatchEngine:
     def _run_mixed(self):
         comm0 = self._eff_begin()
         L = self.prefill_chunk
+        proposals = self._proposals
         ids = np.zeros((self.n_slots, L), np.int32)
         seq_lens = np.zeros((self.n_slots,), np.int32)
         pre_toks = dec_rows = 0
@@ -1227,21 +1360,38 @@ class BatchEngine:
                     self.journey.event(s.req.req_id, "prefill_chunk",
                                        tokens=take, budget=budget)
             else:
+                # Decode row, possibly a speculative verify row: the ids
+                # are [last_tok, d_1..d_p] and seq_lens = 1+p — churn in
+                # draft width is pure operand data, same compiled step.
+                props = proposals.get(i, ())
                 ids[i, 0] = s.last_tok
-                seq_lens[i] = 1
+                if props:
+                    ids[i, 1:1 + len(props)] = props
+                seq_lens[i] = 1 + len(props)
                 dec_rows += 1
         offsets, tables, mask = self._operands()
         st = self.pool.state
         key = self._next_key()   # drawn ONCE — retries replay the same key
+        greedy = None
         with _trace.span("mixed_step",
                          prefill_rows=int((seq_lens > 1).sum()),
+                         spec_rows=len(proposals),
                          active=int(sum(s is not None for s in self._slots))):
-            nxt, finite, k, v = self._call_step(
-                "engine.prefill",
-                lambda corrupt: self._mixed_step(
-                    self.engine.params, jnp.asarray(ids), st.k, st.v,
-                    offsets, tables, mask, jnp.asarray(seq_lens), corrupt,
-                    key))
+            if self.spec is not None:
+                nxt, finite, greedy, k, v = self._call_step(
+                    "engine.prefill",
+                    lambda corrupt: self._mixed_step(
+                        self.engine.params, jnp.asarray(ids), st.k, st.v,
+                        offsets, tables, mask, jnp.asarray(seq_lens),
+                        corrupt, key))
+                greedy = np.asarray(greedy)
+            else:
+                nxt, finite, k, v = self._call_step(
+                    "engine.prefill",
+                    lambda corrupt: self._mixed_step(
+                        self.engine.params, jnp.asarray(ids), st.k, st.v,
+                        offsets, tables, mask, jnp.asarray(seq_lens),
+                        corrupt, key))
             nxt = np.asarray(nxt)
         self.pool.state = PagedKVState(k=k, v=v)
         if self.efficiency is not None:
@@ -1267,6 +1417,10 @@ class BatchEngine:
             self._guard_rows(finite)
         for i, s in enumerate(self._slots):
             if s is None:
+                continue            # freed mid-loop (quarantined by guard)
+            props = proposals.get(i)
+            if props and s.offset >= len(s.ctx):
+                self._accept_row(i, s, props, greedy[i], int(nxt[i]))
                 continue
             took = int(seq_lens[i])
             was_prefilling = s.offset < len(s.ctx)
@@ -1280,6 +1434,44 @@ class BatchEngine:
             self._record_token(s, int(nxt[i]))
             if s.req.remaining_new == 0:
                 self._finish(i)
+
+    def _accept_row(self, idx: int, s: _Slot, props: list[int],
+                    greedy_row, nxt_i: int) -> None:
+        """Host-side longest-prefix acceptance for one verify row.
+
+        The row consumed ``[last_tok, d_1..d_p]``; ``greedy_row[j]`` is
+        the model's argmax continuation after position j — exactly the
+        token one-at-a-time greedy decode would emit next. Accept the
+        longest prefix with ``d_{j+1} == greedy_row[j]``, emit it plus
+        the BONUS token ``greedy_row[m]`` (so every verify step advances
+        >= 1 token and the emitted stream is bit-identical to the
+        non-speculative engine's — full acceptance takes the bonus from
+        ``nxt_i``, the canonical last-position sampling path), then roll
+        the kv frontier back over the rejected suffix: ``offset`` simply
+        advances by m+1 instead of p+1 — the stale rows past it are
+        DMA-skipped by seq_lens and overwritten by the next step — and
+        ``KVPool.truncate`` returns now-empty tail blocks."""
+        p = len(props)
+        m = 0
+        while m < p and int(greedy_row[m]) == props[m]:
+            m += 1
+        rid = s.req.req_id
+        s.offset += m + 1
+        self.metrics.inc("spec_verify_rows")
+        self.metrics.inc("spec_proposed_tokens", p)
+        self.metrics.inc("spec_accepted_tokens", m)
+        self.metrics.observe("spec_accept_ratio", m / p)
+        self.spec.controller.record(rid, p, m)
+        if m < p:
+            freed = self.pool.truncate(rid, s.offset)
+            self.metrics.inc("spec_rollback_tokens", p - m)
+            if freed:
+                self.metrics.inc("spec_rollback_blocks", freed)
+        for t in props[:m]:
+            self._record_token(s, t)
+        self._record_token(s, nxt_i if m == p else int(greedy_row[m]))
+        if s.req.remaining_new == 0:
+            self._finish(idx)
 
     # -- driver -------------------------------------------------------------
 

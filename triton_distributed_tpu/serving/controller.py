@@ -21,6 +21,11 @@ steps:
                        how hard load is shed away from burning replicas
   revive               ``Fleet.revive()`` a DEAD replica back to HEALTHY
                        once its cooldown has passed
+  spec_k_cap           ceiling on the speculative draft width (present
+                       only when the plant speculates): verify rows
+                       widen the mixed step, so under decode-TBT
+                       pressure the loop shrinks speculation first and
+                       relaxes it back on a clean OK streak
 
 Because every move lands in step OPERANDS (masks, seq_lens, thresholds,
 scoring weights), adaptation costs zero retraces: ``trace_counts`` stays
@@ -97,12 +102,17 @@ class Knob:
         return float(int(round(x))) if self.integer else x
 
 
-def default_engine_knobs(prefill_chunk: int, admission_pressure: float
-                         ) -> dict:
+def default_engine_knobs(prefill_chunk: int, admission_pressure: float,
+                         spec_k_max: int | None = None) -> dict:
     """The stock knob set for one ``BatchEngine``: budget / pressure /
-    reclaim, bounded around the engine's construction-time values."""
+    reclaim, bounded around the engine's construction-time values. When
+    the engine speculates (``spec_k_max`` is not None) the reserved
+    ``spec_k_cap`` knob joins the set: a hard ceiling on the per-slot
+    draft width that the SLO loop can ratchet down — verify rows widen
+    the mixed step, so under TBT pressure the safest move after
+    narrowing the prefill budget is narrowing speculation."""
     chunk = int(prefill_chunk)
-    return {
+    knobs = {
         "prefill_budget": Knob(
             "prefill_budget", value=float(chunk),
             lo=float(max(1, chunk // 8)), hi=float(chunk),
@@ -116,13 +126,22 @@ def default_engine_knobs(prefill_chunk: int, admission_pressure: float
             "reclaim_headroom", value=0.0, lo=0.0, hi=DEFAULT_RECLAIM_HI,
             step=0.25, relax_to=0.0, tighten_dir=1),
     }
+    if spec_k_max is not None:
+        k_max = max(0, int(spec_k_max))
+        knobs["spec_k_cap"] = Knob(
+            "spec_k_cap", value=float(k_max), lo=0.0, hi=float(k_max),
+            step=float(max(1, k_max // 4)), relax_to=float(k_max),
+            tighten_dir=-1, integer=True)
+    return knobs
 
 
 def default_fleet_knobs(prefill_chunk: int, admission_pressure: float,
-                        warn_penalty: float) -> dict:
+                        warn_penalty: float,
+                        spec_k_max: int | None = None) -> dict:
     """Fleet scope = the engine knobs (applied uniformly to every
     replica) plus the router's WARN shed weight."""
-    knobs = default_engine_knobs(prefill_chunk, admission_pressure)
+    knobs = default_engine_knobs(prefill_chunk, admission_pressure,
+                                 spec_k_max=spec_k_max)
     knobs["warn_shed"] = Knob(
         "warn_shed", value=float(warn_penalty), lo=float(warn_penalty),
         hi=DEFAULT_WARN_SHED_HI, step=0.75, relax_to=float(warn_penalty),
@@ -154,10 +173,12 @@ class Controller:
                 eng0 = fleet.replicas[0].engine
                 knobs = default_fleet_knobs(eng0.prefill_chunk,
                                             fleet.admission_pressure,
-                                            fleet.router.slo_penalty[1])
+                                            fleet.router.slo_penalty[1],
+                                            spec_k_max=self._spec_k_max())
             elif engine is not None:
                 knobs = default_engine_knobs(engine.prefill_chunk,
-                                             engine.admission_pressure)
+                                             engine.admission_pressure,
+                                             spec_k_max=self._spec_k_max())
             else:
                 knobs = default_engine_knobs(64, 0.0)
         self.knobs = knobs
@@ -176,6 +197,20 @@ class Controller:
         # Wall-clock start is DISPLAY ONLY (serve_top's actions/min); it
         # never feeds a decision.
         self._t0 = time.monotonic()
+
+    def _spec_k_max(self) -> int | None:
+        """The speculative-k ceiling of the bound plant, or None when the
+        plant does not speculate (keeps the stock knob set unchanged for
+        non-speculative engines — action logs stay comparable)."""
+        if self.engine is not None:
+            spec = getattr(self.engine, "spec", None)
+            return spec.controller.k_max if spec is not None else None
+        if self.fleet is not None:
+            caps = [rep.engine.spec.controller.k_max
+                    for rep in self.fleet.replicas
+                    if getattr(rep.engine, "spec", None) is not None]
+            return max(caps) if caps else None
+        return None
 
     # -- observation --------------------------------------------------------
 
@@ -326,6 +361,17 @@ class Controller:
         if mv:
             moves.append(mv)
 
+        sk = self.knobs.get("spec_k_cap")
+        if sk is not None:
+            if obs["level"] >= 1 and obs["decode_rows"] > 0:
+                mv = self._propose(sk, sk.lo, "slo pressure: shrink "
+                                              "speculative k")
+            else:
+                mv = self._propose(sk, sk.relax_to,
+                                   "healthy: relax speculative k cap")
+            if mv:
+                moves.append(mv)
+
         w = self.knobs.get("warn_shed")
         if w is not None:
             if obs["level"] >= 1:
@@ -360,6 +406,9 @@ class Controller:
                 self.engine.prefill_budget = int(value)
             elif name == "admission_pressure":
                 self.engine.admission_pressure = float(value)
+            elif name == "spec_k_cap" \
+                    and getattr(self.engine, "spec", None) is not None:
+                self.engine.spec.controller.k_cap = int(value)
         elif self.fleet is not None:
             if name == "warn_shed":
                 self.fleet.router.set_slo_penalty(warn=value)
@@ -371,6 +420,9 @@ class Controller:
                     rep.engine.prefill_budget = int(value)
                 elif name == "admission_pressure":
                     rep.engine.admission_pressure = float(value)
+                elif name == "spec_k_cap" \
+                        and getattr(rep.engine, "spec", None) is not None:
+                    rep.engine.spec.controller.k_cap = int(value)
 
     def _reclaim(self) -> int:
         """Evict unreferenced cached blocks toward the reclaim-headroom

@@ -774,6 +774,32 @@ class Fleet:
                if self.journey is not None else {}),
             **({"efficiency": eff} if (eff := self._efficiency_block())
                else {}),
+            **({"spec": spec} if (spec := self._spec_block()) else {}),
+        }
+
+    def _spec_block(self) -> dict:
+        """Fleet-wide speculation rollup: per-replica live k + acceptance
+        (what serve_top's spec pane renders) and the aggregate acceptance
+        rate recomputed from SUMMED proposed/accepted counts — acceptance
+        is a ratio, and ratios never average across replicas."""
+        per = {}
+        proposed = accepted = 0
+        for rep in self.replicas:
+            spec = getattr(rep.engine, "spec", None)
+            if spec is None:
+                continue
+            st = spec.controller.stats()
+            per[rep.idx] = {"drafter": spec.name, **st}
+            proposed += st["proposed"]
+            accepted += st["accepted"]
+        if not per:
+            return {}
+        return {
+            "replicas": per,
+            "proposed": proposed,
+            "accepted": accepted,
+            "accept_rate": (round(accepted / proposed, 4)
+                            if proposed else 0.0),
         }
 
     def _efficiency_block(self) -> dict:
@@ -813,7 +839,8 @@ class Fleet:
             for k, v in rep.engine.perfdb_sample().items():
                 if (k.endswith("_ms") or k.startswith("pool_")
                         or k.startswith("journey_")
-                        or k in ("mfu", "mbu", "bubble_frac")
+                        or k in ("mfu", "mbu", "bubble_frac",
+                                 "spec_accept_rate")
                         or k.startswith(("tenant_", "eff_"))):
                     # Latency/pool shape is per-replica; journey metrics
                     # come from ONE recorder shared by every replica, so
@@ -825,6 +852,11 @@ class Fleet:
                 out[k] = out.get(k, 0.0) + float(v)
         if self.journey is not None:
             out.update(self.journey.perfdb_sample())
+        spec = self._spec_block()
+        if spec:
+            # Fleet acceptance = summed accepts over summed proposals
+            # (the per-replica ratio was skipped above, not summed).
+            out["spec_accept_rate"] = float(spec["accept_rate"])
         eff = self._efficiency_block()
         if eff and eff["aggregate"].get("steps"):
             agg = eff["aggregate"]

@@ -265,6 +265,52 @@ class KVPool:
                 assert r > 0, f"cached block {b} refcount underflow"
                 self._cached[b] = r - 1
 
+    def truncate(self, seq_id, n_tokens: int) -> int:
+        """Speculative-decoding rollback primitive: shrink ``seq_id``'s
+        table to exactly ``blocks_for(n_tokens)`` blocks, returning the
+        now-empty tail blocks to the free list (PRIVATE blocks) or
+        decrefing them (cache-resident adopted/promoted blocks — they stay
+        resident for the next prefix match, exactly like ``release``).
+
+        The rejected-suffix KV rows inside the LAST kept block are left in
+        place: the slot's kv frontier (``offsets``/``seq_lens`` step
+        operands) already excludes them from attention, and the next
+        accepted token overwrites them — device memory is never touched.
+
+        ``n_tokens`` must be >= 1 (a live sequence always covers its
+        pending token; shrinking to zero is ``release``'s job — an empty
+        table is an invariant violation) and must not exceed the current
+        table's capacity (truncate never grows; that's ``ensure``).
+        Returns the number of blocks returned to the free list (decrefed
+        cached blocks are kept resident and not counted). Pure host-side
+        free-list motion — fault sites don't fire here, so rollback can
+        never half-happen."""
+        table = self._tables.get(seq_id)
+        if table is None:
+            raise KeyError(
+                f"truncate of unknown seq_id {seq_id!r}: never allocated "
+                f"or already released")
+        if n_tokens < 1:
+            raise ValueError(
+                f"truncate to {n_tokens} tokens would leave an empty "
+                f"table; use release() to retire the sequence")
+        keep = self.blocks_for(n_tokens)
+        if keep > len(table):
+            raise ValueError(
+                f"truncate cannot grow: {seq_id!r} owns {len(table)} "
+                f"blocks, {n_tokens} tokens need {keep}")
+        freed = 0
+        while len(table) > keep:
+            b = table.pop()
+            r = self._cached.get(b)
+            if r is None:
+                self._free.append(b)
+                freed += 1
+            else:
+                assert r > 0, f"cached block {b} refcount underflow"
+                self._cached[b] = r - 1
+        return freed
+
     # -- prefix-cache residency (serving/prefix_cache.py drives these) ------
 
     def attach_cache(self, cache) -> None:

@@ -1,0 +1,386 @@
+"""Speculative decoding: drafters, acceptance control, and the spec plan.
+
+Classic draft-then-verify decoding (Leviathan et al., "Fast Inference from
+Transformers via Speculative Decoding", 2023): a cheap drafter proposes up
+to ``k`` tokens per slot, ONE fused mixed step verifies them (per-slot
+``q_lens = 1 + proposed`` — exactly the ragged varlen shape the fused
+paged-attention kernel already serves), and greedy longest-prefix
+acceptance keeps the output BIT-IDENTICAL to non-speculative decoding:
+
+  acceptance rule   with drafts ``d_1..d_p`` and the model's argmax
+                    continuation ``g_0..g_p`` after consuming
+                    ``[last_tok, d_1..d_p]``, accept the longest prefix
+                    ``m`` with ``d_{j+1} == g_j`` for all ``j < m``, then
+                    emit ``d_1..d_m`` plus the BONUS token ``g_m`` — the
+                    model's own next pick after the accepted prefix, i.e.
+                    exactly the token the non-speculative engine would
+                    have produced, one step at a time. Every step emits
+                    at least one token (m = 0 degrades to plain decode).
+
+  rollback          the rejected suffix was written into the KV pool by
+                    the verify step; the slot's kv frontier simply does
+                    not advance over it (offsets/seq_lens are pure step
+                    operands) and ``KVPool.truncate`` returns now-empty
+                    tail blocks. No device memory is touched.
+
+Everything here is HOST-SIDE and deterministic: drafters look up token
+history, the controller is integer arithmetic over acceptance windows.
+Nothing in this module imports jax — the batch engine owns the device.
+
+Drafter determinism across preemption/requeue/fleet-kill is structural:
+``adopt()`` rebuilds the n-gram tables from the REQUEST's token history
+(prompt + output, which ride the ``Request`` across replicas), never from
+drafter-local state, so a request re-adopted anywhere proposes exactly
+what it would have proposed on the original replica (asserted via
+``fingerprint`` in tests/test_speculative.py).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+
+
+class Drafter:
+    """Interface: propose draft tokens for a slot from its token history.
+
+    Lifecycle (driven by the batch engine):
+      adopt(rid, tokens)   slot fill — (re)build ALL per-request state
+                           from ``tokens`` (prompt + prior output);
+      observe(rid, token)  every emitted token (accepted drafts AND the
+                           bonus token), in emission order;
+      propose(rid, max_k)  up to ``max_k`` draft tokens for the next step;
+      release(rid)         slot teardown (finish/preempt/quarantine).
+
+    Implementations must be deterministic functions of the adopt+observe
+    history — no RNG, no wall clock — or replay (preemption recompute,
+    fleet requeue) would diverge from the original timeline.
+    """
+
+    name = "drafter"
+
+    def adopt(self, rid, tokens) -> None:
+        raise NotImplementedError
+
+    def observe(self, rid, token: int) -> None:
+        raise NotImplementedError
+
+    def propose(self, rid, max_k: int) -> list[int]:
+        raise NotImplementedError
+
+    def release(self, rid) -> None:
+        raise NotImplementedError
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup / n-gram drafter (Saxena, "Prompt Lookup Decoding"):
+    propose the continuation that followed the most recent PRIOR
+    occurrence of the history's trailing n-gram, longest n first.
+
+    Per request it keeps the token history plus, per n in
+    [min_n, max_n], a map from n-gram -> end positions of its latest two
+    occurrences. The trailing gram itself is always the latest occurrence,
+    so proposals continue the second-latest one — repeated spans (code,
+    templated text, greedy cycles) draft their own future. O(max_n) per
+    observed token, O(1) per proposal."""
+
+    name = "ngram"
+
+    def __init__(self, *, max_n: int = 3, min_n: int = 1):
+        if not 1 <= min_n <= max_n:
+            raise ValueError(f"need 1 <= min_n <= max_n, got "
+                             f"[{min_n}, {max_n}]")
+        self.max_n = int(max_n)
+        self.min_n = int(min_n)
+        self._hist: dict[object, list[int]] = {}
+        # _occ[rid][n][gram] = (latest_end, previous_end) token-index of
+        # the last token of the gram's two most recent occurrences
+        # (previous_end None if seen once).
+        self._occ: dict[object, dict[int, dict]] = {}
+
+    def _push(self, rid, tok: int) -> None:
+        hist = self._hist[rid]
+        hist.append(int(tok))
+        occ = self._occ[rid]
+        end = len(hist) - 1
+        for n in range(self.min_n, self.max_n + 1):
+            if len(hist) < n:
+                break
+            gram = tuple(hist[-n:])
+            prev = occ[n].get(gram)
+            occ[n][gram] = (end, None if prev is None else prev[0])
+
+    def adopt(self, rid, tokens) -> None:
+        # Rebuild from scratch — NEVER merge into surviving state. A
+        # preempted/requeued request replays (prompt + output) and lands
+        # on byte-identical tables wherever it is re-adopted.
+        self._hist[rid] = []
+        self._occ[rid] = {n: {} for n in
+                          range(self.min_n, self.max_n + 1)}
+        for t in tokens:
+            self._push(rid, t)
+
+    def observe(self, rid, token: int) -> None:
+        self._push(rid, token)
+
+    def propose(self, rid, max_k: int) -> list[int]:
+        hist = self._hist.get(rid)
+        if hist is None or max_k <= 0:
+            return []
+        occ = self._occ[rid]
+        for n in range(self.max_n, self.min_n - 1, -1):
+            if len(hist) < n:
+                continue
+            ent = occ[n].get(tuple(hist[-n:]))
+            if ent is None or ent[1] is None:
+                continue
+            start = ent[1] + 1           # continuation of the PRIOR match
+            cont = hist[start:start + max_k]
+            if cont:
+                return list(cont)
+        return []
+
+    def release(self, rid) -> None:
+        self._hist.pop(rid, None)
+        self._occ.pop(rid, None)
+
+    def fingerprint(self, rid) -> tuple:
+        """Deterministic digest of a request's drafter state (history
+        length + sorted table sizes) — equality across a kill/requeue
+        re-adoption is the replay-determinism witness."""
+        hist = self._hist.get(rid)
+        if hist is None:
+            return ()
+        occ = self._occ[rid]
+        return (len(hist), tuple(hist[-self.max_n:]),
+                tuple(sorted((n, len(t)) for n, t in occ.items())))
+
+
+class ScriptedDrafter(Drafter):
+    """Test double: ``fn(rid, history, max_k) -> list[int]`` proposes;
+    history bookkeeping matches NGramDrafter's adopt/observe contract so
+    acceptance-histogram tests can script exact accept/reject patterns."""
+
+    name = "scripted"
+
+    def __init__(self, fn):
+        self.fn = fn
+        self._hist: dict[object, list[int]] = {}
+
+    def adopt(self, rid, tokens) -> None:
+        self._hist[rid] = [int(t) for t in tokens]
+
+    def observe(self, rid, token: int) -> None:
+        self._hist[rid].append(int(token))
+
+    def propose(self, rid, max_k: int) -> list[int]:
+        hist = self._hist.get(rid)
+        if hist is None or max_k <= 0:
+            return []
+        return [int(t) for t in self.fn(rid, hist, max_k)][:max_k]
+
+    def release(self, rid) -> None:
+        self._hist.pop(rid, None)
+
+
+class LearnedHeadDrafter(Drafter):
+    """Interface point for a future learned draft head (EAGLE-style:
+    a small head over the target model's features proposes tokens).
+    ``head_fn(rid, history, max_k) -> list[int]`` plugs the trained head
+    in; without one this is a declared-but-unavailable drafter, so the
+    wiring (config plumbing, serve_top pane, perfdb fields) can land
+    ahead of the head itself."""
+
+    name = "learned_head"
+
+    def __init__(self, head_fn=None):
+        self.head_fn = head_fn
+        self._hist: dict[object, list[int]] = {}
+
+    def _require(self):
+        if self.head_fn is None:
+            raise NotImplementedError(
+                "LearnedHeadDrafter has no trained head attached; pass "
+                "head_fn or use NGramDrafter")
+
+    def adopt(self, rid, tokens) -> None:
+        self._require()
+        self._hist[rid] = [int(t) for t in tokens]
+
+    def observe(self, rid, token: int) -> None:
+        self._hist[rid].append(int(token))
+
+    def propose(self, rid, max_k: int) -> list[int]:
+        self._require()
+        if max_k <= 0:
+            return []
+        return [int(t)
+                for t in self.head_fn(rid, self._hist[rid], max_k)][:max_k]
+
+    def release(self, rid) -> None:
+        self._hist.pop(rid, None)
+
+
+class SpecController:
+    """Acceptance-driven adaptive ``k`` with hysteresis.
+
+    Per request it keeps a window of the last ``window`` (proposed,
+    accepted) verify outcomes and moves that request's ``k``:
+
+      shrink  acceptance rate <= ``shrink_at`` over a full-enough window
+              HALVES k immediately (wasted verify width is pure cost —
+              get out fast). Hitting 0 turns speculation off for the
+              request until the window refills with post-shrink evidence.
+      grow    rate >= ``grow_at`` grows k by 1, at most once per
+              ``grow_cooldown`` verify steps (slow up, fast down — the
+              same asymmetric hysteresis the serving controller uses).
+
+    Direction flips are counted as ``reversals`` (the oscillation
+    observable the perf gate tracks lower-better). ``k_cap`` is the
+    fleet/SLO-side clamp: the serving controller's ``spec_k_cap`` knob
+    (reserved since the controller PR) actuates it — WARN pressure caps
+    every request's k without touching per-request acceptance state, so
+    when pressure clears, k pops back to what acceptance supports.
+
+    ``adaptive=False`` pins k at ``k_init`` (bench static arms).
+    All integer host arithmetic — deterministic under replay.
+    """
+
+    def __init__(self, *, k_init: int = 2, k_min: int = 0, k_max: int = 8,
+                 window: int = 16, min_samples: int = 4,
+                 grow_at: float = 0.8, shrink_at: float = 0.4,
+                 grow_cooldown: int = 4, adaptive: bool = True):
+        if not 0 <= k_min <= k_init <= k_max:
+            raise ValueError(f"need 0 <= k_min <= k_init <= k_max, got "
+                             f"{k_min}/{k_init}/{k_max}")
+        self.k_init, self.k_min, self.k_max = k_init, k_min, k_max
+        self.window, self.min_samples = window, min_samples
+        self.grow_at, self.shrink_at = grow_at, shrink_at
+        self.grow_cooldown = grow_cooldown
+        self.adaptive = adaptive
+        self.k_cap = k_max          # external (SLO controller) clamp
+        self._k: dict[object, int] = {}
+        self._win: dict[object, collections.deque] = {}
+        self._since_grow: dict[object, int] = {}
+        self._last_dir: dict[object, int] = {}
+        # lifetime counters (survive request forget — they are fleet
+        # observables, not per-request state)
+        self.proposed = 0
+        self.accepted = 0
+        self.verify_steps = 0
+        self.reversals = 0
+        self.grows = 0
+        self.shrinks = 0
+
+    def k_for(self, rid) -> int:
+        """Draft width for the next step: the request's adaptive k under
+        the external cap. New requests start at ``k_init``."""
+        k = self._k.get(rid, self.k_init)
+        return max(0, min(k, self.k_cap, self.k_max))
+
+    def record(self, rid, proposed: int, accepted: int) -> None:
+        """One verify outcome. ``proposed`` may be 0 (plain decode step,
+        e.g. drafter had nothing) — recorded so the window reflects real
+        goodput, but k only moves on actual verify evidence."""
+        self.verify_steps += 1
+        self.proposed += proposed
+        self.accepted += accepted
+        if not self.adaptive:
+            return
+        win = self._win.get(rid)
+        if win is None:
+            win = self._win[rid] = collections.deque(maxlen=self.window)
+        self._since_grow[rid] = self._since_grow.get(rid, 0) + 1
+        if proposed <= 0:
+            return
+        win.append((proposed, accepted))
+        if len(win) < self.min_samples:
+            return
+        tot_p = sum(p for p, _ in win)
+        tot_a = sum(a for _, a in win)
+        rate = tot_a / tot_p if tot_p else 0.0
+        k = self._k.get(rid, self.k_init)
+        if rate <= self.shrink_at and k > self.k_min:
+            self._move(rid, max(self.k_min, k // 2), -1)
+            win.clear()              # demand post-shrink evidence
+        elif (rate >= self.grow_at and k < self.k_max
+              and self._since_grow[rid] >= self.grow_cooldown):
+            self._move(rid, k + 1, +1)
+            self._since_grow[rid] = 0
+
+    def _move(self, rid, new_k: int, direction: int) -> None:
+        self._k[rid] = new_k
+        if direction > 0:
+            self.grows += 1
+        else:
+            self.shrinks += 1
+        last = self._last_dir.get(rid)
+        if last is not None and last != direction:
+            self.reversals += 1
+        self._last_dir[rid] = direction
+
+    def forget(self, rid) -> None:
+        """Drop per-request state (finish/quarantine). NOT called on
+        preemption — a requeued request's acceptance history is still
+        the best predictor for its recompute replay."""
+        self._k.pop(rid, None)
+        self._win.pop(rid, None)
+        self._since_grow.pop(rid, None)
+        self._last_dir.pop(rid, None)
+
+    @property
+    def accept_rate(self) -> float:
+        return self.accepted / self.proposed if self.proposed else 0.0
+
+    def stats(self) -> dict:
+        ks = sorted(self._k.values())
+        return {
+            "k_init": self.k_init, "k_cap": self.k_cap,
+            "k_live_min": ks[0] if ks else self.k_init,
+            "k_live_max": ks[-1] if ks else self.k_init,
+            "tracked": len(self._win),
+            "proposed": self.proposed, "accepted": self.accepted,
+            "accept_rate": round(self.accept_rate, 4),
+            "verify_steps": self.verify_steps,
+            "grows": self.grows, "shrinks": self.shrinks,
+            "reversals": self.reversals,
+        }
+
+    def perfdb_sample(self) -> dict:
+        return {"spec_accept_rate": round(self.accept_rate, 4),
+                "spec_k_reversals": self.reversals,
+                "spec_k_grows": self.grows,
+                "spec_k_shrinks": self.shrinks}
+
+
+@dataclasses.dataclass
+class Speculative:
+    """The speculative plan a BatchEngine runs: who proposes (drafter)
+    and how wide (controller). One plan per engine; a fleet passes one
+    plan per replica or shares a drafter (safe: all drafter state is
+    request-keyed and rebuilt on adopt)."""
+
+    drafter: Drafter
+    controller: SpecController
+
+    @property
+    def name(self) -> str:
+        return self.drafter.name
+
+
+def as_speculative(value) -> Speculative | None:
+    """Normalize the ``BatchEngine(speculative=...)`` argument:
+    False/None -> off; True -> NGramDrafter + default SpecController;
+    a Drafter -> that drafter + default controller; a Speculative plan
+    passes through."""
+    if value is None or value is False:
+        return None
+    if value is True:
+        return Speculative(drafter=NGramDrafter(),
+                           controller=SpecController())
+    if isinstance(value, Speculative):
+        return value
+    if isinstance(value, Drafter):
+        return Speculative(drafter=value, controller=SpecController())
+    raise TypeError(f"speculative= expects bool, Drafter, or Speculative, "
+                    f"got {type(value).__name__}")
