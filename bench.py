@@ -1083,6 +1083,120 @@ def _bench_serve_efficiency() -> dict:
     }
 
 
+def _bench_serve_incidents() -> dict:
+    """The ``--serve --incidents`` arm: cost and precision of the
+    always-on incident engine (obs/incident.py) vs the same engine with
+    detection off — the same two-engine interleaved-rounds protocol as
+    the efficiency arm, so drift cancels:
+
+        incidents_overhead_frac = (t_on - t_off) / t_off
+
+    gated at ≤5% on real hardware, recorded-not-gated off-TPU. Asserted
+    everywhere: greedy output bit-identical with detection on, zero
+    retraces (detection is pure host arithmetic; ``trace_counts`` stays
+    {1,1}), the detectors actually observed the run (n_steps > 0), and
+    the clean benchmark workload opened ZERO incidents — the flap-freedom
+    gate under benchmark load, not just idle."""
+    import time as _time
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    config = ModelConfig.from_name("tiny", max_length=256)
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    kw = dict(n_slots=4, n_blocks=48, block_size=16, prefill_chunk=32)
+    be_on = BatchEngine(engine, **kw)          # detection on (the default)
+    be_off = BatchEngine(engine, **kw, incidents=False)
+
+    rng = np.random.default_rng(0)
+    n_req, gen = 16, 8
+    prompts = [rng.integers(0, config.vocab_size,
+                            size=int(rng.integers(24, 49))).tolist()
+               for _ in range(n_req)]
+
+    def run_pass(be, tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        t0 = _time.perf_counter()
+        done = be.run(max_steps=5000)
+        dt = _time.perf_counter() - t0
+        return [done[r] for r in rids], dt
+
+    out_on, _ = run_pass(be_on, "warm-on")     # compiles off the clock
+    out_off, _ = run_pass(be_off, "warm-off")
+    if out_on != out_off:
+        raise RuntimeError("incident engine changed greedy output")
+
+    rounds = 6 if on_tpu else 3
+    t_on, t_off = [], []
+    for r in range(rounds):                    # interleaved: drift cancels
+        _, dt = run_pass(be_off, f"r{r}-off")
+        t_off.append(dt)
+        _, dt = run_pass(be_on, f"r{r}-on")
+        t_on.append(dt)
+    s_off, s_on = min(t_off), min(t_on)
+    frac = (s_on - s_off) / s_off
+
+    for be, tag in ((be_on, "on"), (be_off, "off")):
+        retr = be.trace_counts["decode"] + be.trace_counts["prefill"] - 2
+        if retr:
+            raise RuntimeError(f"incidents-{tag} engine retraced {retr}x")
+        be.pool.check_invariants()
+
+    inc = be_on.incidents
+    if inc is None:
+        raise RuntimeError("incident engine missing — must be always-on "
+                           "by default")
+    if be_off.incidents is not None:
+        raise RuntimeError("incidents=False still attached an engine")
+    if not inc.n_steps:
+        raise RuntimeError("incident engine observed zero steps over a "
+                           "full serving run")
+    st = inc.stats()
+    if st["total"] or st["open"]:
+        raise RuntimeError(
+            f"clean benchmark workload opened {st['total']} incident(s) "
+            "— detectors flapped under steady load")
+    snap = be_on.stats_snapshot()              # exercised, must be JSON-able
+    json.dumps(snap, default=str)
+    if "incidents" not in snap:
+        raise RuntimeError("stats_snapshot() lost the incidents block")
+    ok = (frac <= 0.05) or not on_tpu
+    extras = {
+        "serve_incidents_off_s": round(s_off, 6),
+        "serve_incidents_on_s": round(s_on, 6),
+        "incidents_overhead_ok": ok,
+        "incidents_overhead_gated": on_tpu,
+        "serve_incidents_bit_identical": True,
+        "serve_incidents_retraces": 0,
+        "incidents_opened": 0,
+        "inc_steps": int(inc.n_steps),
+        "inc_signals": len(inc._detectors),
+    }
+    if not ok:
+        raise RuntimeError(
+            f"incident engine overhead {frac:.1%} exceeds the 5% "
+            f"step-time budget (off={s_off:.4f}s on={s_on:.4f}s)")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "incidents_overhead_frac",
+        "value": round(frac, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 # --- adaptive-control arm (--serve --adaptive) -----------------------------
 #
 # Deterministic virtual-time cost model: one BatchEngine step costs a fixed
@@ -1634,12 +1748,14 @@ def main():
         adaptive = "--adaptive" in sys.argv
         with_journey = "--journey" in sys.argv
         with_efficiency = "--efficiency" in sys.argv
+        with_incidents = "--incidents" in sys.argv
         with_spec = "--spec" in sys.argv
         metric = ("spec_goodput_under_slo" if with_spec
                   else "goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
                   else "journey_overhead_frac" if with_journey
                   else "efficiency_overhead_frac" if with_efficiency
+                  else "incidents_overhead_frac" if with_incidents
                   else "prefix_hit_rate")
         try:
             if with_spec:
@@ -1652,6 +1768,8 @@ def main():
                 result = _bench_serve_journey()
             elif with_efficiency:
                 result = _bench_serve_efficiency()
+            elif with_incidents:
+                result = _bench_serve_incidents()
             else:
                 result = _bench_serve_prefix()
         except Exception as e:  # noqa: BLE001
@@ -1669,6 +1787,7 @@ def main():
                               else "serve_slo" if with_slo
                               else "serve_journey" if with_journey
                               else "serve_efficiency" if with_efficiency
+                              else "serve_incidents" if with_incidents
                               else "serve_prefix"))
         return
 

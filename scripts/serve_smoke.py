@@ -24,6 +24,12 @@ workload through a speculative engine (n-gram drafter + fused verify +
 KV rollback) and a plain engine, asserting byte-identical outputs,
 nonzero accepted draft tokens, and zero retraces on either engine.
 
+``--incidents`` runs the incident-engine arm: a clean closed-loop phase
+that must open ZERO incidents (flap-freedom/precision), then a seeded
+NaN fault plan at ``engine.decode`` that must open >= 1 incident whose
+TOP-ranked suspect names the injected site with near-immediate detection
+latency (recall + attribution).
+
 ``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
 N replicas behind the cache/SLO-aware router. Plain run: everything
 completes, no replica leaves the ROUTABLE states, every replica's two
@@ -428,6 +434,136 @@ def main_spec(*, seed: int = 0, n_requests: int = 16, gen: int = 32,
     return result
 
 
+def main_incidents(*, seed: int = 0, warmup: int = 32,
+                   chaos_requests: int = 24,
+                   perfdb_path: str | None = None,
+                   stats_jsonl: str | None = None) -> dict:
+    """The ``--incidents`` arm: precision AND recall of the always-on
+    incident engine on one run. Phase 1 is a clean closed-loop workload —
+    the engine must open ZERO incidents (the flap-freedom gate). Phase 2
+    installs a seeded NaN fault plan at ``engine.decode``; the resulting
+    quarantines drive the ``requests_failed`` counter detector, and the
+    run fails unless >= 1 incident opens, its TOP-ranked suspect names
+    the injected site (cross-layer triage found the right culprit, not
+    just *a* culprit), and detection latency stays within the hysteresis
+    bound. Both compiled steps must still trace exactly once. Raises
+    RuntimeError on any violation."""
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import (
+        FaultPlan,
+        FaultSpec,
+        faults,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny", max_length=128)
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    be = BatchEngine(engine, n_slots=4, n_blocks=96, block_size=4,
+                     prefill_chunk=8)
+    if be.incidents is None:
+        raise RuntimeError("incident engine not attached — it must be "
+                           "always-on by default")
+    if stats_jsonl:
+        be.stream_stats(stats_jsonl, interval_s=0.5)
+    rng = np.random.default_rng(seed)
+    start = time.monotonic()
+
+    def one_request(gen: int = 8):
+        prompt = rng.integers(0, config.vocab_size,
+                              size=int(rng.integers(6, 12))).tolist()
+        be.submit(prompt, max_new_tokens=gen)
+
+    # Phase 1 — clean closed-loop load: the precision gate. Every level
+    # detector builds its healthy baseline here; nothing may trip.
+    for _ in range(warmup):
+        one_request()
+        be.run()
+    clean = be.incidents.stats()
+    if clean["total"] or clean["open"]:
+        raise RuntimeError(
+            f"clean workload opened {clean['total']} incident(s) — the "
+            "detectors flapped on a healthy trace")
+
+    # Phase 2 — seeded chaos: NaN-poisoned logit rows at engine.decode.
+    # Each bite quarantines the slot-0 request, bumping requests_failed —
+    # a counter detector structurally at zero on a healthy run, so the
+    # trip is deterministic-given-the-plan, not a latency threshold.
+    plan = FaultPlan([
+        FaultSpec(site="engine.decode", kind="nan", p=0.6, row=0,
+                  start_after=2),
+    ], seed=seed)
+    with faults.plan(plan):
+        for _ in range(chaos_requests):
+            one_request()
+            be.run()
+    if not plan.n_fired:
+        raise RuntimeError("seeded NaN plan never fired — no chaos to "
+                           "detect")
+
+    m = be.metrics.as_dict()
+    failed = int(m.get("requests_failed", 0))
+    if not failed:
+        raise RuntimeError("chaos phase quarantined nothing — the NaN "
+                           "plan fired but no request failed")
+    be.pool.check_invariants()
+    for kind, n in be.trace_counts.items():
+        if n > 1:
+            raise RuntimeError(
+                f"{kind} step retraced {n} times with the incident "
+                "engine attached — detection must be data, not shape")
+
+    dump = be.incidents.dump()
+    rows = dump["incidents"]
+    if not rows:
+        raise RuntimeError(
+            f"{failed} quarantines produced NO incident — the counter "
+            "detector missed a structural failure burst")
+    top = rows[0]
+    suspects = top.get("suspects", [])
+    if not suspects:
+        raise RuntimeError("incident opened with an EMPTY suspect list — "
+                           "triage saw none of the evidence")
+    if suspects[0]["site"] != "engine.decode":
+        raise RuntimeError(
+            f"triage mis-attributed the incident: top suspect "
+            f"{suspects[0]['site']!r} (score {suspects[0]['score']}), "
+            "expected 'engine.decode' — the injected fault site must "
+            "outrank downstream symptoms")
+    lat = int(top["detect_latency_steps"])
+    if lat > 4:
+        raise RuntimeError(f"detection latency {lat} steps — counter "
+                           "trips must be near-immediate")
+
+    result = {
+        "requests_submitted": warmup + chaos_requests,
+        "requests_completed": int(m.get("requests_completed", 0)),
+        "requests_failed": failed,
+        "wall_s": round(time.monotonic() - start, 3),
+        "faults_injected": plan.n_fired,
+        "incidents_opened": dump["opened"],
+        "incidents_open": be.incidents.n_open,
+        "detect_latency_steps": lat,
+        "top_suspect": suspects[0],
+        "incident_severity": top["severity"],
+        "trace_count_decode": be.trace_counts["decode"],
+        "trace_count_prefill": be.trace_counts["prefill"],
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = be.perfdb_sample()
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_incidents", metrics=sample,
+            meta={"seed": seed, "warmup": warmup,
+                  "chaos_requests": chaos_requests})
+        result["perfdb_run_id"] = rec.run_id
+    return result
+
+
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
@@ -637,6 +773,10 @@ if __name__ == "__main__":
                     help="run the adaptive-control arm: overload burst "
                          "drives WARN, the controller actuates, recovery "
                          "walks back to OK with zero BREACH")
+    ap.add_argument("--incidents", action="store_true",
+                    help="run the incident-engine arm: clean phase must "
+                         "open zero incidents; seeded NaN chaos must open "
+                         ">=1 with the injected site top-ranked")
     ap.add_argument("--spec", action="store_true",
                     help="run the speculative-decoding arm: same workload "
                          "through spec and plain engines; assert zero "
@@ -647,7 +787,15 @@ if __name__ == "__main__":
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.spec:
+        if args.incidents:
+            if args.chaos or args.replicas > 1 or args.adaptive or args.spec:
+                raise SystemExit("--incidents is its own arm; run it "
+                                 "without --chaos/--replicas/--adaptive/"
+                                 "--spec")
+            metrics = main_incidents(seed=args.seed,
+                                     perfdb_path=args.perfdb,
+                                     stats_jsonl=args.stats_jsonl)
+        elif args.spec:
             if args.chaos or args.replicas > 1 or args.adaptive:
                 raise SystemExit("--spec is its own arm; run it without "
                                  "--chaos/--replicas/--adaptive")

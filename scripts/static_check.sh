@@ -15,6 +15,12 @@
 #                                      '+probe' variants) at world 2/4/8.
 #   3. tools/check_no_bare_print.py -> no bare print() in package or tools
 #                                      code (dist_print only).
+#   4. tools/check_perfdb_directions.py -> every metric key recorded into
+#                                      the perf run database resolves to a
+#                                      known gate direction (or is declared
+#                                      neutral context / a boolean witness)
+#                                      so perf_gate.py never silently
+#                                      waves a regression through.
 #
 # Usage: bash scripts/static_check.sh [--tier1]
 #   --tier1  additionally run the tier-1 pytest suite after the static
@@ -75,6 +81,10 @@ if python tools/check_no_bare_print.py; then
 else
     rc=1
 fi
+
+echo
+echo "== perfdb direction lint (tools/check_perfdb_directions.py) =="
+python tools/check_perfdb_directions.py || rc=1
 
 if [[ "${1:-}" == "--tier1" ]]; then
     echo

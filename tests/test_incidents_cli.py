@@ -1,0 +1,98 @@
+"""Tier-1 wiring for tools/incidents.py: the postmortem report must be
+byte-identical per seed (the determinism acceptance gate), the built-in
+demo must detect its own injected fault with the fault site top-ranked,
+and the journal mode must load both raw ``dump()`` files and
+``stats_snapshot()["incidents"]`` wrappers with the documented exit codes
+(0 report, 1 detection/lookup failure, 2 unreadable input).
+"""
+
+import importlib.util
+import json
+import pathlib
+
+import pytest
+
+from triton_distributed_tpu.obs.incident import IncidentEngine, SignalSpec
+
+_TOOL = pathlib.Path(__file__).parent.parent / "tools" / "incidents.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("incidents_cli", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return _load()
+
+
+def test_demo_byte_identical_per_seed(mod):
+    a = mod.render(mod.run_demo(0))
+    b = mod.render(mod.run_demo(0))
+    assert a == b
+    assert a != mod.render(mod.run_demo(7))   # the seed actually steers it
+
+
+def test_demo_detects_its_own_fault(mod):
+    dump = mod.run_demo(0)
+    mod.check_demo(dump)                       # raises on any miss
+    inc = dump["incidents"][0]
+    assert inc["suspects"][0]["site"] == mod._DEMO_SITE
+    assert inc["detect_latency_steps"] <= mod._DEMO_LATENCY_BOUND
+    report = mod.render(dump)
+    assert mod._DEMO_SITE in report
+    assert "CRITICAL" in report
+
+
+def _dump():
+    eng = IncidentEngine(signals=[SignalSpec("c", kind="counter")],
+                         replica=0)
+    eng.observe({"c": 0.0})
+    eng.observe({"c": 2.0})
+    return eng.dump()
+
+
+def test_journal_modes_and_exit_codes(mod, tmp_path, capsys):
+    raw = tmp_path / "raw.json"
+    raw.write_text(json.dumps(_dump()))
+    assert mod.main(["--journal", str(raw)]) == 0
+    out = capsys.readouterr().out
+    assert "c" in out and "CRITICAL" in out
+    # stats_snapshot()["incidents"] wrapper: same incidents, one level in.
+    wrapped = tmp_path / "wrapped.json"
+    wrapped.write_text(json.dumps({"incidents": _dump()}))
+    assert mod.main(["--journal", str(wrapped)]) == 0
+    # --id selects one incident; an unknown id is a lookup failure (1).
+    assert mod.main(["--journal", str(raw), "--id", "0"]) == 0
+    capsys.readouterr()
+    assert mod.main(["--journal", str(raw), "--id", "99"]) == 1
+    # Unreadable / non-JSON input exits 2.
+    assert mod.main(["--journal", str(tmp_path / "missing.json")]) == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    assert mod.main(["--journal", str(bad)]) == 2
+    # A JSON file with no incident list anywhere is a format error (1).
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps({"foo": 1}))
+    assert mod.main(["--journal", str(empty)]) == 1
+
+
+def test_out_flag_writes_report(mod, tmp_path):
+    src = tmp_path / "d.json"
+    src.write_text(json.dumps(_dump()))
+    dst = tmp_path / "report.md"
+    assert mod.main(["--journal", str(src), "--out", str(dst)]) == 0
+    assert "CRITICAL" in dst.read_text()
+
+
+def test_mode_mutual_exclusion(mod):
+    # Exactly one of --demo / --journal; argparse errors exit 2.
+    with pytest.raises(SystemExit) as e:
+        mod.main([])
+    assert e.value.code == 2
+    with pytest.raises(SystemExit) as e:
+        mod.main(["--demo", "--journal", "x.json"])
+    assert e.value.code == 2

@@ -13,13 +13,17 @@ import jax.numpy as jnp
 import pytest
 
 from triton_distributed_tpu.obs import comm_ledger
+from triton_distributed_tpu.obs import metrics as metrics_mod
 from triton_distributed_tpu.obs import trace
 from triton_distributed_tpu.obs.metrics import (
     Histogram,
     Metrics,
     parse_prometheus,
 )
-from triton_distributed_tpu.obs.window import DEFAULT_BOUNDS
+from triton_distributed_tpu.obs.window import (
+    DEFAULT_BOUNDS,
+    WindowRing,
+)
 from triton_distributed_tpu.runtime import perf_model as pm
 
 
@@ -597,3 +601,179 @@ def test_peak_bf16_tflops_single_source():
 def test_hbm_gbps_from_table():
     assert pm.hbm_gbps(V5E) == pytest.approx(819.0)
     assert pm.hbm_gbps() > 0          # detect_hardware fallback path
+
+
+def test_prometheus_hostile_label_values_roundtrip():
+    """Structural characters in label VALUES — quotes, backslashes,
+    newlines, commas, braces, equals — must survive exposition and parse
+    back to the exact internal series key. Both sides escape: the
+    exposition writes 0.0.4 quoted values, the internal flat key
+    backslash-escapes its own structural set; a mismatch on either side
+    makes the round-trip key unsplittable or ambiguous."""
+    hostile = [
+        'a,b=c',                 # internal structural chars
+        'quo"te',                # exposition structural char
+        'back\\slash',
+        'new\nline',
+        'brace}close{open',
+        '\\,=}"\n\\\\',          # everything at once, incl. trailing run
+        '',                      # empty value
+    ]
+    m = Metrics()
+    for i, v in enumerate(hostile):
+        m.set_gauge("g", float(i), labels={"path": v, "idx": str(i)})
+        m.inc("hits", i + 1.0, labels={"path": v})
+    parsed = parse_prometheus(m.to_prometheus())
+    for i, v in enumerate(hostile):
+        gkey = metrics_mod._series_key("g", {"path": v, "idx": str(i)})
+        assert parsed[gkey] == float(i), f"gauge lost for {v!r}"
+        ckey = metrics_mod._series_key("hits_total", {"path": v})
+        assert parsed[ckey] == i + 1.0, f"counter lost for {v!r}"
+        # ...and the flat key itself splits back to the raw value.
+        name, labels = metrics_mod._split_series(gkey, quoted=False)
+        assert name == "g" and labels["path"] == v
+    # Distinct hostile values never collide into one series.
+    assert len([k for k in parsed if k.startswith("g{")]) == len(hostile)
+
+
+def test_prometheus_hostile_label_names_and_metric_names():
+    # Label/metric NAMES are sanitized (exposition forbids escapes there);
+    # values survive verbatim alongside.
+    m = Metrics()
+    m.set_gauge("lat.p99-s", 7.0, labels={"the key": 'v"al'})
+    text = m.to_prometheus()
+    assert "lat_p99_s" in text
+    parsed = parse_prometheus(text)
+    assert parsed[metrics_mod._series_key("lat_p99_s",
+                                          {"the_key": 'v"al'})] == 7.0
+
+
+def test_merge_chrome_traces_dedupes_metadata(tmp_path):
+    """Multi-source merge schema: ph:"M" process/thread metadata repeated
+    across per-rank files (one rank contributes host + device + journey
+    rows, each re-stating its track names) collapses to first-occurrence;
+    data events pass through untouched, in file order."""
+    meta_p0 = [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+         "args": {"name": "rank 0"}},
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 1,
+         "args": {"name": "host"}},
+    ]
+    ev = {"ph": "X", "name": "work", "pid": 0, "tid": 1, "ts": 1.0,
+          "dur": 2.0, "args": {}}
+    (tmp_path / "trace.p0.json").write_text(json.dumps(
+        {"traceEvents": meta_p0 + [ev] + meta_p0}))      # dup in-file
+    (tmp_path / "trace.p1.json").write_text(json.dumps(
+        {"traceEvents": [
+            meta_p0[0],                                  # dup cross-file
+            {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+             "args": {"name": "rank 0 DIFFERENT"}},      # same ids, new args
+            {"ph": "M", "name": "process_name", "pid": 1, "tid": 0,
+             "args": {"name": "rank 1"}},
+            dict(ev, pid=1, ts=5.0),
+        ]}))
+    merged = json.loads(open(trace.merge_chrome_traces(str(tmp_path)))
+                        .read())
+    assert set(merged) == {"traceEvents", "displayTimeUnit"}
+    events = merged["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    data = [e for e in events if e["ph"] != "M"]
+    # Exact-duplicate metadata collapsed; differing args kept (they are a
+    # different declaration, not a repeat).
+    keys = [(e["name"], e["pid"], e["tid"],
+             json.dumps(e["args"], sort_keys=True)) for e in meta]
+    assert len(keys) == len(set(keys)) == 4
+    assert [e["ts"] for e in data] == [1.0, 5.0]         # file order
+    # Merging the merged file's directory again is stable (idempotent on
+    # the metadata set).
+    again = json.loads(open(trace.merge_chrome_traces(
+        str(tmp_path), out_name="trace.merged2.json")).read())
+    assert [e for e in again["traceEvents"] if e["ph"] == "M"] == meta
+
+
+# ---------------------------------------------------------------------------
+# window quantiles: edge cases vs numpy ground truth
+# ---------------------------------------------------------------------------
+
+
+def _ring(values, clock=lambda: 100.0):
+    r = WindowRing(bucket_s=1.0, n_buckets=64, clock=clock)
+    for v in values:
+        r.observe(v, now=100.0)
+    return r
+
+
+def test_window_quantile_empty_and_single():
+    r = WindowRing(bucket_s=1.0, n_buckets=8, clock=lambda: 0.0)
+    st = r.query(8.0)
+    assert st.count == 0 and st.quantile(50) == 0.0 and st.mean == 0.0
+    assert st.frac_gt(0.0) == 0.0
+    r.observe(0.037)
+    st = r.query(8.0)
+    # One sample: every quantile is that sample (min==max clamps the
+    # in-bucket interpolation to the observed point).
+    for p in (0, 1, 50, 99, 100):
+        assert st.quantile(p) == 0.037
+    assert st.min == st.max == 0.037 and st.count == 1
+
+
+def test_window_quantile_identical_values_and_extremes():
+    st = _ring([0.02] * 1000).query(60.0)
+    for p in (0, 50, 90, 99, 100):
+        assert st.quantile(p) == 0.02
+    # p=0 / p=100 never extrapolate past observed min/max.
+    st = _ring([0.001, 0.01, 0.1]).query(60.0)
+    assert st.quantile(0) == 0.001
+    assert st.quantile(100) == 0.1
+
+
+def test_window_quantile_vs_numpy_within_bucket_error():
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    # Log-uniform over the bucket range: exercises many buckets.
+    vals = list(10.0 ** rng.uniform(-3.5, 1.5, size=2000))
+    st = _ring(vals).query(60.0)
+    assert st.count == 2000
+    assert st.sum == pytest.approx(float(np.sum(vals)))
+    assert st.mean == pytest.approx(float(np.mean(vals)))
+    for p in (50, 90, 99):
+        exact = float(np.percentile(vals, p))
+        got = st.quantile(p)
+        # The documented accuracy contract: the interpolated quantile lands
+        # within the containing bucket, so worst-case relative error is the
+        # log-bucket ratio 10^(1/8) ~ 1.334.
+        ratio = 10.0 ** (1.0 / 8.0)
+        assert exact / ratio <= got <= exact * ratio, (p, got, exact)
+    # frac_gt agrees with the exact empirical fraction to bucket error:
+    # bracket the threshold one bucket either side.
+    for thr in (0.01, 0.1, 1.0):
+        exact = float(np.mean(np.asarray(vals) > thr))
+        lo = float(np.mean(np.asarray(vals) > thr * ratio))
+        hi = float(np.mean(np.asarray(vals) > thr / ratio))
+        assert lo - 1e-9 <= st.frac_gt(thr) <= hi + 1e-9, (thr, exact)
+
+
+def test_window_counter_ring_expiry():
+    # Counter mode (bounds=None): sum()/mean() over the trailing window
+    # only, with lazy O(1) expiry as the fake clock advances.
+    now = [10.0]
+    r = WindowRing(bucket_s=1.0, n_buckets=4, bounds=None,
+                   clock=lambda: now[0])
+    r.observe(3.0)
+    now[0] = 11.0
+    r.observe(5.0)
+    assert r.sum(4.0) == 8.0
+    assert r.query(4.0).counts is None       # no histogram arrays
+    assert r.mean(4.0) == 4.0
+    assert r.rate(4.0) == pytest.approx(8.0 / 4.0)
+    # Advance past the first bucket: 3.0 expires, 5.0 survives.
+    now[0] = 13.5
+    assert r.sum(3.0) == 5.0
+    # Advance past the ring: everything expires; the slot is reset on
+    # touch, not by a timer.
+    now[0] = 30.0
+    assert r.sum(4.0) == 0.0 and r.query(4.0).count == 0
+    # Windows longer than the ring clamp to the ring.
+    assert r.max_window_s == 4.0
+    assert r.sum(1e9) == 0.0

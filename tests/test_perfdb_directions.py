@@ -1,0 +1,130 @@
+"""Tests for the perfdb direction lint (tools/check_perfdb_directions):
+the repo itself must be clean, a planted undirected metric must be caught
+(in a perfdb_sample body, a bench extras table, and a harness sample
+store), and the two escape hatches — boolean-witness suffixes and the
+declared NEUTRAL_CONTEXT registry — must be honored, so adding a metric
+without a gate direction is a static failure, not a silent ungated key.
+"""
+
+import importlib.util
+import io
+import pathlib
+
+import pytest
+
+from triton_distributed_tpu.obs import perfdb
+
+_REPO = pathlib.Path(__file__).parent.parent
+_TOOL = _REPO / "tools" / "check_perfdb_directions.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location(
+        "check_perfdb_directions", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return _load()
+
+
+def test_repo_is_clean(mod):
+    out = io.StringIO()
+    assert mod.run(str(_REPO), out=out) == 0, out.getvalue()
+    assert "OK" in out.getvalue()
+
+
+def test_repo_covers_known_recording_sites(mod):
+    keys = set()
+    for path in mod.lint_paths(str(_REPO)):
+        keys.update(k for k, _ in mod.scan_file(path))
+    # Spot-check that the walk actually reaches all three site classes:
+    # perfdb_sample() bodies, bench extras tables, harness sample stores.
+    assert "incidents_open" in keys          # obs/incident.perfdb_sample
+    assert "incidents_overhead_frac" in keys  # bench.py headline metric
+    assert len(keys) >= 100
+
+
+def test_planted_unknown_keys_caught(mod, tmp_path):
+    (tmp_path / "bench.py").write_text(
+        "def arm():\n"
+        "    extras = {'mystery_widget': 3.0}\n"
+        "    return {'metric': 'unexplained_wobble', 'value': 1.0,\n"
+        "            'extras': extras}\n")
+    scripts = tmp_path / "scripts"
+    scripts.mkdir()
+    (scripts / "smoke.py").write_text(
+        "sample = {}\n"
+        "sample['undirected_thing'] = 2.0\n")
+    pkg = tmp_path / "triton_distributed_tpu"
+    pkg.mkdir()
+    (pkg / "thing.py").write_text(
+        "class T:\n"
+        "    def perfdb_sample(self):\n"
+        "        out = {'orphan_metric': 1.0}\n"
+        "        out['second_orphan'] = 2.0\n"
+        "        return out\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 1
+    text = out.getvalue()
+    for key in ("mystery_widget", "unexplained_wobble", "undirected_thing",
+                "orphan_metric", "second_orphan"):
+        assert key in text, f"lint missed planted key {key!r}"
+
+
+def test_escape_hatches_honored(mod, tmp_path):
+    # Directed keys, a boolean witness, and a declared-neutral key: clean.
+    (tmp_path / "bench.py").write_text(
+        "def arm():\n"
+        "    extras = {'synthetic_p99_s': 0.1,\n"
+        "              'synthetic_bit_identical': True,\n"
+        "              'inc_steps': 10.0}\n"
+        "    return {'metric': 'decode_tbt_p99_s', 'value': 1.0,\n"
+        "            'extras': extras}\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 0, out.getvalue()
+    # Verbose mode labels each class.
+    out = io.StringIO()
+    mod.run(str(tmp_path), verbose=True, out=out)
+    text = out.getvalue()
+    assert "synthetic_bit_identical -> exempt" in text
+    assert "inc_steps -> neutral-context" in text
+    assert "synthetic_p99_s -> lower-better" in text
+
+
+def test_non_sample_dicts_ignored(mod, tmp_path):
+    # A dict that is neither a perfdb_sample body, an extras table, nor a
+    # recognized sample store must not be linted — the lint is scoped to
+    # recording sites, not every string-keyed dict in the tree.
+    pkg = tmp_path / "triton_distributed_tpu"
+    pkg.mkdir()
+    (pkg / "thing.py").write_text(
+        "CONFIG = {'whatever_key': 1}\n"
+        "def f():\n"
+        "    d = {}\n"
+        "    d['not_a_metric'] = 2\n")
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 0, out.getvalue()
+    assert "(0 recorded keys" in out.getvalue()
+
+
+def test_neutral_context_registry_semantics():
+    # The registry is the deliberate escape hatch: membership is exact,
+    # and a neutral key must NOT also carry a direction (that would be a
+    # contradiction — gated and declared-ungated at once).
+    assert perfdb.is_neutral_context("inc_steps")
+    assert not perfdb.is_neutral_context("inc_steps_extra")
+    for key in sorted(perfdb.NEUTRAL_CONTEXT):
+        assert perfdb.metric_direction(key) == 0, (
+            f"{key!r} is declared neutral but also resolves to a gate "
+            "direction — remove it from NEUTRAL_CONTEXT")
+
+
+def test_cli_entrypoint(mod, capsys):
+    assert mod.main(["--root", str(_REPO)]) == 0
+    capsys.readouterr()
+    assert mod.main(["--root", str(_REPO / "no-such-dir")]) == 2

@@ -3,6 +3,7 @@ Poisson load through the serving subsystem, failing on pool leaks, lost
 requests, or any step retrace beyond the first compile."""
 
 import importlib.util
+import json
 import pathlib
 
 _SCRIPT = pathlib.Path(__file__).parent.parent / "scripts" / "serve_smoke.py"
@@ -182,3 +183,30 @@ def test_serve_smoke_chaos():
     assert m["trace_count_prefill"] == 1
     # the fault plane actually exercised the retry path
     assert m.get("step_retries", 0) + m.get("alloc_retries", 0) > 0
+
+
+def test_serve_smoke_incidents(tmp_path):
+    """The --incidents mode's detection contract end-to-end: a clean
+    closed-loop phase opens ZERO incidents (precision), the seeded NaN
+    chaos phase opens at least one CRITICAL incident whose top-ranked
+    suspect is the injected fault site with near-immediate detection
+    (recall + triage), and the always-on observer never retraces the
+    compiled steps (main_incidents() raises on any violation — this test
+    runs that contract under tier 1 and pins the perfdb keys)."""
+    db = tmp_path / "perf.jsonl"
+    m = _load().main_incidents(seed=0, perfdb_path=str(db))
+    assert m["requests_failed"] >= 1
+    assert m["faults_injected"] >= 1
+    assert m["incidents_opened"] >= 1
+    assert m["incident_severity"] == "CRITICAL"
+    assert m["detect_latency_steps"] <= 4
+    assert m["top_suspect"]["site"] == "engine.decode"
+    assert m["top_suspect"]["kind"] == "fault:nan"
+    assert "requests_failed" in m["top_suspect"]["chain"]
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+    rows = [json.loads(line) for line in db.read_text().splitlines()]
+    assert rows and rows[-1]["suite"] == "serve_smoke_incidents"
+    metrics = rows[-1]["metrics"]
+    assert metrics["incidents_total"] >= 1
+    assert metrics["detect_latency_steps"] <= 4

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""check_perfdb_directions: static lint — every recorded perf metric must
+have a KNOWN gate direction.
+
+``tools/perf_gate.py`` can only gate a metric it knows the direction of
+(``obs/perfdb.py:metric_direction``: -1 lower-better, +1 higher-better);
+direction-0 keys are reported informationally and NEVER fail the gate, so
+a regression in one sails through silently. This lint walks the repo's
+recording sites statically and fails when any recorded key resolves to
+direction 0:
+
+  * every ``perfdb_sample()`` method body — dict-literal keys and
+    ``out["key"] = ...`` subscript stores;
+  * ``bench.py`` — the ``extras = {...}`` tables and every arm's headline
+    ``"metric"`` name;
+  * the ``scripts/*.py`` harnesses — ``sample["key"] = ...`` stores on
+    the dict handed to ``PerfDB.append``.
+
+Two escape hatches, both deliberate:
+
+  * boolean witness keys (``*_ok``, ``*_gated``, ``*_identical``,
+    ``*_match``) — ``perfdb._numeric_metrics`` drops bools before they
+    ever reach the database, so they carry no gate direction by design;
+  * keys in ``perfdb.NEUTRAL_CONTEXT`` — workload-scaled counts and
+    config echoes DECLARED context-only. The declaration is the point:
+    a new key must either carry a direction hint or be added to that
+    list on purpose, never land ungated by accident.
+
+    python tools/check_perfdb_directions.py          # lint the repo
+    python tools/check_perfdb_directions.py -v       # list every key
+
+Exit 0 when every recorded key has a direction, 1 when any is unknown,
+2 on usage errors. Wired into scripts/static_check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.obs.perfdb import (  # noqa: E402
+    is_neutral_context,
+    metric_direction,
+)
+
+# Boolean witnesses: recorded for the smoke asserts, dropped by
+# _numeric_metrics before ingest — no direction needed or possible.
+_EXEMPT_SUFFIXES = ("_ok", "_gated", "_identical", "_match")
+# Dict names whose subscript stores feed PerfDB.append in the harnesses.
+_SAMPLE_NAMES = ("sample", "out", "flat")
+
+
+def _is_exempt(key: str) -> bool:
+    return key.endswith(_EXEMPT_SUFFIXES)
+
+
+def _dict_str_keys(node: ast.Dict):
+    for k in node.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            yield k.value, k.lineno
+
+
+class _Collector(ast.NodeVisitor):
+    """Collects (key, lineno) metric-name candidates from one module."""
+
+    def __init__(self, *, is_bench: bool, is_script: bool):
+        self.is_bench = is_bench
+        self.is_script = is_script
+        self.keys: list[tuple[str, int]] = []
+        self._in_sample_fn = 0
+
+    # -- perfdb_sample() bodies: everything string-keyed is a metric ------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if node.name == "perfdb_sample":
+            self._in_sample_fn += 1
+            self.generic_visit(node)
+            self._in_sample_fn -= 1
+        else:
+            self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Dict(self, node: ast.Dict):
+        if self._in_sample_fn:
+            self.keys.extend(_dict_str_keys(node))
+        elif self.is_bench:
+            # bench arms: the extras table plus the headline metric name
+            # out of {"metric": "...", "extras": {...}} result dicts.
+            keys = {k.value for k in node.keys
+                    if isinstance(k, ast.Constant)
+                    and isinstance(k.value, str)}
+            if "metric" in keys and "extras" in keys:
+                for k, v in zip(node.keys, node.values):
+                    if (isinstance(k, ast.Constant) and k.value == "metric"
+                            and isinstance(v, ast.Constant)
+                            and isinstance(v.value, str)):
+                        self.keys.append((v.value, v.lineno))
+        self.generic_visit(node)
+
+    def visit_Assign(self, node: ast.Assign):
+        # extras = {...} tables in bench arms.
+        if (self.is_bench and isinstance(node.value, ast.Dict)
+                and any(isinstance(t, ast.Name) and t.id == "extras"
+                        for t in node.targets)):
+            self.keys.extend(_dict_str_keys(node.value))
+        # sample["key"] = ... stores in the harnesses and sample fns.
+        for t in node.targets:
+            if (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)
+                    and isinstance(t.slice, ast.Constant)
+                    and isinstance(t.slice.value, str)
+                    and (self._in_sample_fn
+                         or ((self.is_script or self.is_bench)
+                             and t.value.id in _SAMPLE_NAMES))):
+                self.keys.append((t.slice.value, t.lineno))
+        self.generic_visit(node)
+
+
+def scan_file(path: str) -> list[tuple[str, int]]:
+    """All metric-name candidates recorded by ``path``: (key, lineno)."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    base = os.path.basename(path)
+    col = _Collector(is_bench=(base == "bench.py"),
+                     is_script=(os.path.basename(os.path.dirname(path))
+                                == "scripts"))
+    col.visit(ast.parse(src, filename=path))
+    return col.keys
+
+
+def lint_paths(root: str) -> list[str]:
+    """The files this lint covers, relative to ``root``."""
+    paths = [os.path.join(root, "bench.py")]
+    for sub in ("triton_distributed_tpu", "scripts"):
+        for dirpath, _dirs, files in sorted(os.walk(os.path.join(root, sub))):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in sorted(files) if f.endswith(".py"))
+    return [p for p in paths if os.path.exists(p)]
+
+
+def run(root: str, *, verbose: bool = False, out=sys.stdout) -> int:
+    n_keys = 0
+    violations: list[str] = []
+    for path in lint_paths(root):
+        rel = os.path.relpath(path, root)
+        for key, lineno in scan_file(path):
+            n_keys += 1
+            if _is_exempt(key):
+                status = "exempt"
+            elif is_neutral_context(key):
+                status = "neutral-context"
+            elif metric_direction(key) == 0:
+                status = "UNKNOWN"
+                violations.append(f"{rel}:{lineno}: metric {key!r} has no "
+                                  "gate direction")
+            else:
+                status = {-1: "lower-better",
+                          1: "higher-better"}[metric_direction(key)]
+            if verbose:
+                out.write(f"{rel}:{lineno}: {key} -> {status}\n")
+    if violations:
+        out.write("\n".join(violations) + "\n")
+        out.write(f"check_perfdb_directions: {len(violations)} of "
+                  f"{n_keys} recorded keys have UNKNOWN direction — add a "
+                  "hint/override in obs/perfdb.py (or rename the metric "
+                  "to carry one)\n")
+        return 1
+    out.write(f"check_perfdb_directions: OK ({n_keys} recorded keys, all "
+              "directed or exempt)\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every discovered key and its direction")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        sys.stderr.write(f"check_perfdb_directions: no such root: "
+                         f"{args.root}\n")
+        return 2
+    return run(args.root, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,298 @@
+#!/usr/bin/env python
+"""incidents: postmortem markdown report for detected serving incidents.
+
+Renders the bounded incident ring the incident engine (``obs/incident.py``)
+accumulates — one section per incident: the step interval, every tripped
+signal with its healthy baseline and peak deviation, the deterministically
+scored suspect ranking with each suspect's causal chain, and (for
+SLO-breach incidents) the compact forensic-bundle summary.
+
+    # post-hoc, from a dumped journal (BatchEngine.resilience_snapshot()
+    # written as JSON, or a raw IncidentEngine.dump())
+    python tools/incidents.py --journal snap.json
+    python tools/incidents.py --journal snap.json --id 2
+
+    # self-contained deterministic demo: scripted signal trace + seeded
+    # fault plan driving a real IncidentEngine -> byte-identical report
+    # per seed (no accelerator, no wall-clock)
+    python tools/incidents.py --demo --seed 0
+
+The ``--demo`` mode replays a deterministic serving-signal trace (seeded
+pseudo-noise baseline, a scripted latency excursion, a failure-counter
+bump) against an injected ``engine.decode`` fault plan, then CHECKS the
+engine's verdict: at least one incident must open, its top-ranked suspect
+must name the injected site, and detection latency must stay within the
+hysteresis bound. Exit 0 clean; 1 when a check fails (no incident, wrong
+attribution, unbounded latency — or a malformed journal); 2 on usage/IO
+errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/incidents.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.obs.incident import (  # noqa: E402
+    IncidentEngine,
+    SignalSpec,
+)
+
+# The demo's injected fault site — the attribution check's ground truth.
+_DEMO_SITE = "engine.decode"
+# Detection-latency bound the demo enforces: trip_after plus one sample
+# of slack. A latency past this means hysteresis is broken.
+_DEMO_LATENCY_BOUND = 4
+
+
+# -- rendering ---------------------------------------------------------------
+
+def _fmt(v) -> str:
+    return f"{float(v):.6f}"
+
+
+def _signal_lines(signals: dict) -> list[str]:
+    lines = ["### Tripped signals", "",
+             "| signal | kind | peak value | baseline | deviation | "
+             "first anomaly step |",
+             "|---|---|---:|---:|---:|---:|"]
+    for name in sorted(signals):
+        d = signals[name]
+        lines.append(
+            f"| {name} | {d.get('kind', '?')} | {_fmt(d.get('value', 0.0))}"
+            f" | {_fmt(d.get('baseline', 0.0))} | "
+            f"{_fmt(d.get('deviation', 0.0))} | "
+            f"{d.get('first_anomaly_step', '-')} |")
+    lines.append("")
+    return lines
+
+
+def _suspect_lines(suspects: list) -> list[str]:
+    lines = ["### Suspect ranking", ""]
+    if not suspects:
+        lines.append("(no correlated evidence: the interval overlapped no "
+                     "fault firing, blackbox event, comm slowdown, or "
+                     "controller action)")
+        lines.append("")
+        return lines
+    lines.append("| rank | site | kind | score | evidence | causal chain |")
+    lines.append("|---:|---|---|---:|---|---|")
+    for rank, s in enumerate(suspects, start=1):
+        ev = ", ".join(f"{k}={v}" for k, v in
+                       sorted(s.get("evidence", {}).items()))
+        lines.append(
+            f"| {rank} | {s.get('site', '?')} | {s.get('kind', '?')} | "
+            f"{_fmt(s.get('score', 0.0))} | {ev} | {s.get('chain', '')} |")
+    lines.append("")
+    return lines
+
+
+def _forensic_lines(forensic: dict) -> list[str]:
+    lines = ["### Forensic bundle summary", "",
+             "| field | value |", "|---|---|"]
+    for k in sorted(forensic):
+        v = forensic[k]
+        if isinstance(v, dict):
+            v = ", ".join(f"{kk}={vv}" for kk, vv in sorted(v.items()))
+        lines.append(f"| {k} | {v} |")
+    lines.append("")
+    return lines
+
+
+def _incident_lines(inc: dict) -> list[str]:
+    where = ""
+    if inc.get("replicas") is not None:
+        where = " on replicas " + ",".join(
+            "fleet" if r < 0 else str(r) for r in inc["replicas"])
+    elif inc.get("replica") is not None:
+        where = f" on replica {inc['replica']}"
+    closed = inc.get("step_closed")
+    lines = [
+        f"## Incident #{inc.get('id', '?')}: {inc.get('kind', '?')} "
+        f"({inc.get('severity', '?')}){where}", "",
+        "| field | value |",
+        "|---|---|",
+        f"| state | {inc.get('state', '?')} |",
+        f"| first anomalous sample | step "
+        f"{inc.get('step_first_anomaly', '?')} |",
+        f"| opened | step {inc.get('step_open', '?')} |",
+        f"| closed | {'step ' + str(closed) if closed is not None else 'still open'} |",
+        f"| detection latency | {inc.get('detect_latency_steps', '?')} "
+        "steps |",
+        "",
+    ]
+    lines += _signal_lines(inc.get("signals", {}))
+    lines += _suspect_lines(inc.get("suspects", []))
+    if inc.get("forensic"):
+        lines += _forensic_lines(inc["forensic"])
+    return lines
+
+
+def render(dump: dict, *, only_id: int | None = None) -> str:
+    """Full markdown report for one ``IncidentEngine.dump()`` (or the
+    fleet-merged block: same row schema, ``ring`` instead of
+    ``incidents``)."""
+    rows = dump.get("incidents", dump.get("ring", []))
+    if only_id is not None:
+        rows = [r for r in rows if r.get("id") == only_id]
+        if not rows:
+            raise LookupError(f"incident id {only_id} not in the journal "
+                              f"(have {len(dump.get('incidents', []))})")
+    n_open = sum(1 for r in rows if r.get("step_closed") is None)
+    lines = [
+        "# incidents report", "",
+        "| field | value |",
+        "|---|---|",
+        f"| incidents | {len(rows)} |",
+        f"| open | {n_open} |",
+        f"| engine steps observed | {dump.get('steps', '?')} |",
+        f"| opened (lifetime) | {dump.get('opened', len(rows))} |",
+        f"| evicted from ring | {dump.get('evicted', 0)} |",
+        "",
+    ]
+    if not rows:
+        lines.append("No incidents: every detector stayed within its "
+                     "healthy baseline for the whole trace.")
+        lines.append("")
+    for inc in rows:
+        lines += _incident_lines(inc)
+    return "\n".join(lines)
+
+
+# -- journal mode ------------------------------------------------------------
+
+def load_journal(path: str) -> dict:
+    """Accept either a raw ``IncidentEngine.dump()`` or a full
+    ``resilience_snapshot()`` / ``stats_snapshot()`` carrying an
+    ``incidents`` block."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    if "incidents" in doc and isinstance(doc["incidents"], dict):
+        doc = doc["incidents"]          # snapshot wrapper
+    if not isinstance(doc.get("incidents", doc.get("ring")), list):
+        raise ValueError(
+            f"{path}: no incident list found (expected an "
+            "IncidentEngine.dump(), a resilience_snapshot(), or a fleet "
+            "incidents block)")
+    return doc
+
+
+# -- demo mode ---------------------------------------------------------------
+
+def run_demo(seed: int) -> dict:
+    """Deterministic end-to-end exercise of detect + triage, no serving
+    stack required: a seeded pseudo-noise baseline, a scripted latency
+    excursion riding an injected ``engine.decode`` delay fault, and a
+    failure-counter bump attributed through the fault log. Everything —
+    noise, fault plan, detector state — derives from ``seed`` and the
+    step ordinal, so the rendered report is byte-identical per seed."""
+    from triton_distributed_tpu.resilience import faults
+    from triton_distributed_tpu.resilience.faults import (
+        FaultPlan,
+        FaultSpec,
+    )
+
+    eng = IncidentEngine(signals=[
+        SignalSpec("tbt_p99_s", direction=1),
+        SignalSpec("mfu", direction=-1),
+        SignalSpec("requests_failed", kind="counter"),
+    ])
+    plan = FaultPlan([
+        # Every decode call past the excursion start is delayed (0 s: the
+        # LOG is the evidence, the demo never sleeps).
+        FaultSpec(_DEMO_SITE, "delay", p=1.0, delay_s=0.0,
+                  start_after=120, max_fires=40),
+    ], seed=seed)
+    rng = random.Random(seed)
+    failed = 0.0
+    with faults.plan(plan):
+        eng.fault_log_source = lambda: plan.log
+        for step in range(320):
+            faults.fire(_DEMO_SITE)     # call_index advances every step
+            noise = 0.0008 * rng.random()
+            tbt = 0.011 + noise
+            mfu = 0.42 - 10.0 * noise
+            if 120 <= step < 200:       # the excursion window
+                tbt += 0.06
+                mfu -= 0.25
+                if step >= 130:
+                    failed = 3.0
+            eng.observe({"tbt_p99_s": tbt, "mfu": mfu,
+                         "requests_failed": failed})
+    return eng.dump()
+
+
+def check_demo(dump: dict) -> None:
+    """The demo's acceptance gates (exit 1 on failure)."""
+    rows = dump["incidents"]
+    if not rows:
+        raise ValueError("demo trace produced NO incident — detectors "
+                         "missed a 6x latency excursion")
+    top = rows[0]
+    suspects = top.get("suspects", [])
+    if not suspects or suspects[0].get("site") != _DEMO_SITE:
+        got = suspects[0].get("site") if suspects else None
+        raise ValueError(
+            f"triage mis-attributed the demo incident: top suspect "
+            f"{got!r}, expected {_DEMO_SITE!r}")
+    lat = int(top.get("detect_latency_steps", 1 << 30))
+    if lat > _DEMO_LATENCY_BOUND:
+        raise ValueError(
+            f"detection latency {lat} steps exceeds the hysteresis bound "
+            f"({_DEMO_LATENCY_BOUND})")
+
+
+# -- entry -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--journal", default=None,
+                    help="JSON journal to read (IncidentEngine.dump() or "
+                         "a resilience/stats snapshot with an incidents "
+                         "block)")
+    ap.add_argument("--id", type=int, default=None,
+                    help="render only this incident id (with --journal)")
+    ap.add_argument("--demo", action="store_true",
+                    help="run the seeded deterministic demo instead of "
+                         "reading a journal")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="demo seed (noise + fault plan)")
+    ap.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    if args.demo == (args.journal is not None):
+        ap.error("pick exactly one mode: --demo or --journal PATH")
+
+    try:
+        if args.demo:
+            dump = run_demo(args.seed)
+            check_demo(dump)
+        else:
+            dump = load_journal(args.journal)
+        report = render(dump, only_id=args.id)
+    except (OSError, json.JSONDecodeError) as e:
+        sys.stderr.write(f"incidents: {e}\n")
+        return 2
+    except (LookupError, ValueError) as e:
+        sys.stderr.write(f"incidents: {e}\n")
+        return 1
+
+    report += "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(report)
+        sys.stdout.write(f"wrote {args.out}\n")
+    else:
+        sys.stdout.write(report)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

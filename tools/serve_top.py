@@ -220,6 +220,35 @@ def _spec_lines(spec: dict) -> list[str]:
     return lines
 
 
+def _incident_lines(inc: dict) -> list[str]:
+    """The incident pane (``stats_snapshot()['incidents']``): open/total
+    counts plus one row per recent incident — state, severity, step
+    window, tripped signals, and the top triage suspect's causal chain.
+    Engine and fleet (merged) shapes share the ring-row schema."""
+    sev = {0: "ok", 1: "WARN", 2: "CRIT"}.get(
+        int(inc.get("severity_level", 0)), "?")
+    lines = [
+        f"  inc    open={int(inc.get('open', 0))} ({sev})  "
+        f"total={int(inc.get('total', 0))}  "
+        f"detect_latency={int(inc.get('detect_latency_steps', 0))} steps",
+    ]
+    for row in inc.get("ring", ())[-4:]:
+        steps = f"{row.get('step_open', 0)}-" + (
+            str(row.get("step_closed"))
+            if row.get("step_closed") is not None else "open")
+        sigs = ",".join(sorted(row.get("signals", {})))
+        top = (row.get("suspects") or [{}])[0]
+        lines.append(
+            f"    #{row.get('id', 0)} {str(row.get('kind', '?')):<10} "
+            f"{str(row.get('severity', '?')):<4} steps {steps:<12} "
+            f"[{sigs}]")
+        if top.get("site"):
+            lines.append(f"       suspect {top['site']} "
+                         f"score={top.get('score', 0.0)}  "
+                         f"{top.get('chain', '')}")
+    return lines
+
+
 def render(snap: dict) -> str:
     """Render one ``BatchEngine.stats_snapshot()`` (or
     ``Fleet.stats_snapshot()``) dict as a text frame."""
@@ -274,6 +303,9 @@ def render(snap: dict) -> str:
     eff = snap.get("efficiency")
     if eff:
         lines.extend(_efficiency_lines(eff))
+    inc = snap.get("incidents")
+    if inc:
+        lines.extend(_incident_lines(inc))
     drops = []
     bb = snap.get("blackbox")
     if bb:
@@ -382,6 +414,29 @@ def _demo_snapshot(i: int) -> dict:
                 {"tenant": "beta", "tokens": 40 * i,
                  "flop_s": 0.3 * i, "cost_frac": 0.25},
             ]},
+        "incidents": {
+            "open": 1 if slow else 0, "total": 1 + i // 30, "closed":
+            i // 30, "evicted": 0, "steps": 200 * i,
+            "severity_level": 2 if slow else 0,
+            "detect_latency_steps": 3,
+            "ring": [{
+                "id": i // 30, "kind": "anomaly", "severity": "CRITICAL",
+                "state": "open" if slow else "closed",
+                "step_first_anomaly": 200 * i - 8,
+                "step_open": 200 * i - 6,
+                "step_closed": None if slow else 200 * i - 2,
+                "detect_latency_steps": 3,
+                "signals": {"tbt_p99_s": {"kind": "level", "value": 0.18,
+                                          "baseline": 0.012, "deviation":
+                                          0.168, "first_anomaly_step":
+                                          200 * i - 8}},
+                "suspects": [{"site": "engine.decode", "kind":
+                              "fault:delay", "score": 10.1,
+                              "evidence": {"fires": 3},
+                              "chain": "engine.decode fault:delay -> "
+                                       "tbt_p99_s -> CRITICAL"}],
+            }] if i >= 20 else [],
+        },
         "blackbox": {"len": 512, "recorded": 600 * i, "dropped":
                      max(0, 600 * i - 512)},
         "trace_dropped_spans": 0,

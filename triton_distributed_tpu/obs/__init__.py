@@ -39,6 +39,11 @@ runs for weeks):
   TailSampler      (obs.trace) per-request trace sampling that always
                    keeps slow/errored requests plus a deterministic
                    head-sampled fraction.
+  obs.incident     always-on incident engine: deterministic robust-z +
+                   CUSUM changepoint detectors with hysteresis over the
+                   live signal set, cross-layer forensic auto-triage
+                   into a ranked suspect list, and a bounded incident
+                   ring with cross-replica merge.
 
 Perf flight recorder (on top of the three views above):
 
@@ -59,6 +64,7 @@ permanently. Design note: docs/observability.md.
 from triton_distributed_tpu.obs import blackbox  # noqa: F401
 from triton_distributed_tpu.obs import comm_ledger  # noqa: F401
 from triton_distributed_tpu.obs import efficiency  # noqa: F401
+from triton_distributed_tpu.obs import incident  # noqa: F401
 from triton_distributed_tpu.obs import journey  # noqa: F401
 from triton_distributed_tpu.obs import perfdb  # noqa: F401
 from triton_distributed_tpu.obs import roofline  # noqa: F401
@@ -78,6 +84,11 @@ from triton_distributed_tpu.obs.comm_ledger import (  # noqa: F401
 from triton_distributed_tpu.obs.efficiency import (  # noqa: F401
     EfficiencyLedger,
     StepAttribution,
+)
+from triton_distributed_tpu.obs.incident import (  # noqa: F401
+    Incident,
+    IncidentEngine,
+    SignalSpec,
 )
 from triton_distributed_tpu.obs.perfdb import (  # noqa: F401
     FingerprintMismatch,
@@ -111,11 +122,12 @@ from triton_distributed_tpu.obs.window import (  # noqa: F401
 
 __all__ = [
     "Blackbox", "CommLedger", "EfficiencyLedger", "FingerprintMismatch",
-    "Histogram", "Journey", "JourneyContext", "JourneyRecorder",
-    "LedgerEntry", "Metrics", "Objective", "PerfDB", "RequestTrace",
-    "RooflineRecord", "RunRecord", "SLOEngine", "SpanRecord",
-    "StepAttribution", "TailSampler", "Tracer", "Verdict", "WindowRing",
-    "WindowStats", "blackbox", "comm_ledger", "default_serving_slo",
-    "efficiency", "group_profile", "journey", "merge_chrome_traces",
-    "parse_prometheus", "perfdb", "roofline", "slo", "trace", "window",
+    "Histogram", "Incident", "IncidentEngine", "Journey", "JourneyContext",
+    "JourneyRecorder", "LedgerEntry", "Metrics", "Objective", "PerfDB",
+    "RequestTrace", "RooflineRecord", "RunRecord", "SLOEngine",
+    "SignalSpec", "SpanRecord", "StepAttribution", "TailSampler", "Tracer",
+    "Verdict", "WindowRing", "WindowStats", "blackbox", "comm_ledger",
+    "default_serving_slo", "efficiency", "group_profile", "incident",
+    "journey", "merge_chrome_traces", "parse_prometheus", "perfdb",
+    "roofline", "slo", "trace", "window",
 ]

@@ -1,0 +1,725 @@
+"""Always-on incident engine: deterministic online anomaly detection with
+cross-layer forensic auto-triage.
+
+The repo emits a dozen independent telemetry streams — metric windows
+(obs/window.py), SLO burn rates (obs/slo.py), blackbox lifecycle events
+(obs/blackbox.py), request journeys (obs/journey.py), the comm ledger
+(obs/comm_ledger.py), the efficiency ledger (obs/efficiency.py) — but
+until this module nothing *watched* them. ``IncidentEngine`` closes that
+gap: it rides ``BatchEngine.step()`` as a pure host-side observer (one
+``observe()`` call per step, no compiled state touched, ``trace_counts``
+stays {1,1}), runs two deterministic online detectors per signal, and
+when one trips it assembles an ``Incident`` — the step interval, the
+tripped signal(s), a severity — and performs automatic cross-layer
+triage into a deterministically scored, ranked suspect list.
+
+Detectors (both bounded-memory, both wall-clock-free — every decision is
+a pure function of the observed sample sequence, so the same trace
+yields byte-identical incidents):
+
+  robust z      baseline = median/MAD over a bounded deque of samples
+                recorded while the signal was HEALTHY (an anomaly never
+                poisons its own baseline). A sample is anomalous when its
+                directional robust z-score exceeds ``z_thresh`` AND the
+                deviation clears a relative floor (absolute guard against
+                MAD collapsing on near-constant signals).
+  CUSUM         one-sided cumulative sum of scaled deviations minus a
+                slack ``cusum_k`` (in MAD units), tripping at
+                ``cusum_h`` — catches slow drifts a per-sample z-test
+                misses.
+
+Hysteresis wraps both: a signal must be anomalous ``trip_after``
+consecutive samples to trip (flap suppression — the clean-trace
+zero-false-positive gate), and an open incident needs ``clear_after``
+consecutive clean samples across ALL its signals to close. Counter-kind
+signals (quarantines, requeues, failures — structurally zero on a
+healthy run) trip on any positive delta with ``trip_after=1``.
+
+Triage is cursor-based interval correlation: every ``observe()`` the
+engine snapshots cheap cursors (fault-plan log length, blackbox
+``n_recorded``, controller action count, comm-ledger wall totals); when
+an incident trips, the evidence is exactly the items that arrived
+between the first anomalous sample's cursor and now — fault firings by
+site, blackbox quarantine/preempt/backpressure events, controller knob
+moves, comm-ledger deltas, efficiency worst-bubble steps, tail journey
+exemplars. Each evidence class maps to a suspect with a deterministic
+score (fault sites dominate, control actions rank as *responses*), and
+the ranked list carries a one-line causal chain, e.g.::
+
+    engine.decode nan fault -> requests_failed delta -> CRITICAL
+
+Surfaces: ``BatchEngine.stats_snapshot()["incidents"]`` /
+``Fleet.stats_snapshot()["incidents"]`` (cross-replica merge: incidents
+whose step windows overlap collapse into ONE fleet incident),
+``tools/incidents.py`` (postmortem markdown report, byte-identical per
+seed), the serve_top ``inc`` pane, SLO-BREACH / watchdog integration
+(a breach opens a critical incident wrapping the forensic bundle), the
+controller's ``incidents_open`` observation, and the perfdb keys
+``incidents_open`` / ``incidents_total`` / ``detect_latency_steps``
+(all lower-better; see ``obs/perfdb.py``'s direction table).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+
+# Severity ladder (matches the SLO state ladder in spirit: a WARN-grade
+# anomaly vs a CRITICAL fault/breach).
+WARN = "WARN"
+CRITICAL = "CRITICAL"
+_SEV_LEVEL = {WARN: 1, CRITICAL: 2}
+
+# Signal kinds.
+LEVEL = "level"        # continuous signal: robust-z + CUSUM
+COUNTER = "counter"    # cumulative counter: any positive delta is anomalous
+
+# Evidence -> suspect score weights. Fault injections are near-certain
+# causes; quarantines are their symptom; comm slowdowns and host bubbles
+# are mid-chain; controller actions are usually a RESPONSE to pressure,
+# not its cause, so they rank last. All floats exact in binary, so
+# ranking is bit-stable.
+_W_FAULT = 8.0
+_W_QUARANTINE = 4.0
+_W_SLO = 3.0
+_W_COMM = 2.5
+_W_BUBBLE = 1.5
+_W_CONTROLLER = 1.0
+
+
+def _median(xs: list[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if not n:
+        return 0.0
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+@dataclasses.dataclass
+class SignalSpec:
+    """Detection policy for one named signal.
+
+    ``direction`` +1 means anomalous when ABOVE baseline (latency, bubble,
+    queue wait); -1 means anomalous when BELOW (MFU, MBU, acceptance,
+    achieved-over-estimate). ``rel_floor`` is the minimum |deviation| as a
+    fraction of ``max(|median|, abs_floor)`` — the guard that keeps a
+    near-constant signal's collapsed MAD from amplifying noise into
+    incidents on a clean trace."""
+
+    name: str
+    direction: int = 1
+    kind: str = LEVEL
+    z_thresh: float = 6.0
+    cusum_k: float = 3.0          # per-sample slack, MAD units
+    cusum_h: float = 24.0         # decision threshold, MAD units
+    min_samples: int = 48         # baseline warmup before judging
+    trip_after: int = 3           # consecutive anomalous samples to trip
+    clear_after: int = 8          # consecutive clean samples to clear
+    rel_floor: float = 0.5
+    abs_floor: float = 1e-4
+    baseline_n: int = 128         # healthy-sample deque length
+
+    def __post_init__(self):
+        if self.direction not in (1, -1):
+            raise ValueError(f"direction must be +1/-1, got {self.direction}")
+        if self.kind not in (LEVEL, COUNTER):
+            raise ValueError(f"unknown signal kind {self.kind!r}")
+
+
+def default_signals() -> list[SignalSpec]:
+    """The stock serving signal set the ``BatchEngine`` feeds: trailing
+    tbt/queue-wait percentiles, efficiency ratios, speculative acceptance,
+    comm achieved-over-estimate, and the fault-symptom counters."""
+    return [
+        # Latency tails carry magnitude floors: on a lightly loaded engine
+        # the healthy medians are single-digit milliseconds, and a lone
+        # scheduler/GC hiccup can be 30x that without being incident-grade.
+        # The floor pins the 6-sigma line at a deviation an operator would
+        # actually page on (>= 6 * rel_floor * abs_floor), while a loaded
+        # engine's larger median takes over the scaling automatically.
+        SignalSpec("tbt_p99_s", direction=1, abs_floor=0.05),
+        SignalSpec("queue_wait_p99_s", direction=1, abs_floor=0.25),
+        SignalSpec("mfu", direction=-1),
+        SignalSpec("mbu", direction=-1),
+        SignalSpec("bubble_frac", direction=1, abs_floor=0.05),
+        SignalSpec("accept_rate", direction=-1, abs_floor=0.05),
+        SignalSpec("achieved_over_est", direction=1),
+        SignalSpec("requests_failed", kind=COUNTER),
+        SignalSpec("quarantines", kind=COUNTER),
+        SignalSpec("requeues", kind=COUNTER),
+    ]
+
+
+class _Detector:
+    """Per-signal online state: healthy baseline deque, CUSUM accumulator,
+    and the trip/clear hysteresis streaks."""
+
+    __slots__ = ("spec", "baseline", "cusum", "anom_streak", "clean_streak",
+                 "last", "n_seen", "first_anom_step", "peak_dev",
+                 "peak_value", "tripped")
+
+    def __init__(self, spec: SignalSpec):
+        self.spec = spec
+        self.baseline: deque = deque(maxlen=spec.baseline_n)
+        self.cusum = 0.0
+        self.anom_streak = 0
+        self.clean_streak = 0
+        self.last: float | None = None
+        self.n_seen = 0
+        self.first_anom_step: int | None = None
+        self.peak_dev = 0.0
+        self.peak_value = 0.0
+        self.tripped = False
+
+    def _scale(self, med: float) -> float:
+        devs = [abs(x - med) for x in self.baseline]
+        mad = _median(devs)
+        # MAD -> sigma-equivalent; floored so a constant baseline doesn't
+        # divide by ~0 and call the first wiggle a 1e9-sigma event.
+        spec = self.spec
+        return max(mad / 0.6745, spec.rel_floor
+                   * max(abs(med), spec.abs_floor))
+
+    def update(self, step: int, value: float) -> bool:
+        """Feed one sample; returns True while the detector is TRIPPED
+        (post-hysteresis)."""
+        spec = self.spec
+        prev = self.last
+        self.last = value
+        self.n_seen += 1
+        if spec.kind == COUNTER:
+            return self._update_counter(step, value)
+        if prev is not None and value == prev and self.anom_streak > 0:
+            # Sticky-window echo: a rolling quantile pinned by a single
+            # spike repeats the exact same float every step until the
+            # spike ages out of the window. Those repeats are the SAME
+            # observation, not fresh evidence — freeze the detector
+            # (no streak, no CUSUM, no clean credit) so one environmental
+            # spike can never trip by echoing, while a real excursion
+            # (fresh samples perturbing the quantile each step) still
+            # counts every sample.
+            return self.tripped
+        anomalous = False
+        if len(self.baseline) >= spec.min_samples:
+            med = _median(list(self.baseline))
+            scale = self._scale(med)
+            dev = spec.direction * (value - med)
+            z = dev / scale
+            # Per-sample contribution capped at z_thresh: CUSUM exists to
+            # catch SUSTAINED drifts the z-test misses, so one giant spike
+            # must not satisfy h by itself and then keep "anomalous" true
+            # through its residual — that would bypass trip_after (the
+            # z-path already handles genuine multi-sample excursions).
+            # Total capped at 2h: without a ceiling the sum grows with
+            # excursion LENGTH and the clear latency would too; the cap
+            # bounds recovery to ~h/k samples past the excursion,
+            # invariant to its duration.
+            self.cusum = min(
+                max(0.0, self.cusum + min(z, spec.z_thresh) - spec.cusum_k),
+                2.0 * spec.cusum_h)
+            anomalous = z > spec.z_thresh or self.cusum > spec.cusum_h
+            if anomalous and dev > self.peak_dev:
+                self.peak_dev = dev
+                self.peak_value = value
+        if anomalous:
+            self.anom_streak += 1
+            self.clean_streak = 0
+            if self.first_anom_step is None:
+                self.first_anom_step = step
+            if self.anom_streak >= spec.trip_after:
+                self.tripped = True
+        else:
+            self.clean_streak += 1
+            self.anom_streak = 0
+            self.baseline.append(value)     # only healthy samples feed it
+            if self.tripped and self.clean_streak >= spec.clear_after:
+                self.tripped = False
+                self.cusum = 0.0
+                self.first_anom_step = None
+                self.peak_dev = 0.0
+        return self.tripped
+
+    def _update_counter(self, step: int, value: float) -> bool:
+        spec = self.spec
+        prev = self.baseline[-1] if self.baseline else value
+        delta = value - prev
+        self.baseline.append(value)
+        if delta > 0.0:
+            self.anom_streak += 1
+            self.clean_streak = 0
+            if self.first_anom_step is None:
+                self.first_anom_step = step
+            if delta > self.peak_dev:
+                self.peak_dev = delta
+                self.peak_value = value
+            self.tripped = True
+        else:
+            self.clean_streak += 1
+            self.anom_streak = 0
+            if self.tripped and self.clean_streak >= spec.clear_after:
+                self.tripped = False
+                self.first_anom_step = None
+                self.peak_dev = 0.0
+        return self.tripped
+
+
+@dataclasses.dataclass
+class Incident:
+    """One detected anomaly interval plus its triage verdict."""
+
+    id: int
+    kind: str                       # "anomaly" | "slo-breach"
+    severity: str                   # WARN | CRITICAL
+    step_first_anomaly: int
+    step_open: int
+    step_closed: int | None = None  # None while open
+    replica: int | None = None
+    signals: dict = dataclasses.field(default_factory=dict)
+    suspects: list = dataclasses.field(default_factory=list)
+    forensic: dict | None = None    # compact breach bundle summary
+
+    @property
+    def open(self) -> bool:
+        return self.step_closed is None
+
+    @property
+    def detect_latency_steps(self) -> int:
+        return self.step_open - self.step_first_anomaly + 1
+
+    def as_dict(self) -> dict:
+        return {
+            "id": self.id, "kind": self.kind, "severity": self.severity,
+            "state": "open" if self.open else "closed",
+            "step_first_anomaly": self.step_first_anomaly,
+            "step_open": self.step_open, "step_closed": self.step_closed,
+            "detect_latency_steps": self.detect_latency_steps,
+            "replica": self.replica,
+            "signals": {k: dict(v) for k, v in sorted(self.signals.items())},
+            "suspects": [dict(s) for s in self.suspects],
+            **({"forensic": self.forensic} if self.forensic else {}),
+        }
+
+
+class IncidentEngine:
+    """Bounded-memory online watcher over a named signal set.
+
+    ``observe(signals)`` once per engine step with whatever signals are
+    currently measurable (absent/None signals are skipped — a spec-less
+    engine just never feeds ``accept_rate``). Evidence sources are
+    attached as zero-arg callables by the host (``BatchEngine`` wires
+    them); each is polled lazily, only when an incident actually trips.
+    """
+
+    def __init__(self, *, signals: list[SignalSpec] | None = None,
+                 max_incidents: int = 64, replica: int | None = None):
+        specs = default_signals() if signals is None else signals
+        self._detectors = {s.name: _Detector(s) for s in specs}
+        self.max_incidents = int(max_incidents)
+        self.replica = replica
+        self.incidents: deque[Incident] = deque(maxlen=self.max_incidents)
+        self.n_opened = 0
+        self.n_closed = 0
+        self.n_evicted = 0
+        self.n_steps = 0
+        self._open_incident: Incident | None = None
+        # Evidence sources (set by the host engine; all optional).
+        self.fault_log_source = None        # -> list[FaultEvent]
+        self.blackbox_source = None         # -> (n_recorded, events(last=N))
+        self.controller_source = None       # -> list[action dicts]
+        self.comm_source = None             # -> comm_ledger.snapshot() dict
+        self.efficiency_source = None       # -> worst_bubble row list
+        self.journey_source = None          # -> slowest journey rows
+        self.slo_source = None              # -> transitions list
+        # Cursors into the append-only evidence streams, snapshotted at
+        # the FIRST anomalous sample so triage correlates exactly the
+        # incident interval.
+        self._cursors = self._read_cursors()
+        self._anom_cursors: dict | None = None
+
+    # -- cursoring ---------------------------------------------------------
+
+    def _read_cursors(self) -> dict:
+        cur = {}
+        if self.fault_log_source is not None:
+            cur["faults"] = len(self.fault_log_source() or ())
+        if self.blackbox_source is not None:
+            cur["blackbox"] = int(self.blackbox_source()[0])
+        if self.controller_source is not None:
+            cur["controller"] = len(self.controller_source() or ())
+        if self.slo_source is not None:
+            cur["slo"] = len(self.slo_source() or ())
+        return cur
+
+    # -- observation -------------------------------------------------------
+
+    def observe(self, signals: dict) -> Incident | None:
+        """Feed one step's signal bundle; returns the incident OPENED by
+        this step (None otherwise — including while one stays open)."""
+        step = self.n_steps
+        self.n_steps += 1
+        tripped: list[str] = []
+        any_first_anom = False
+        for name, det in self._detectors.items():
+            v = signals.get(name)
+            if v is None:
+                continue
+            was_anom = det.anom_streak > 0 or det.tripped
+            if det.update(step, float(v)):
+                tripped.append(name)
+            if not was_anom and det.anom_streak > 0:
+                any_first_anom = True
+        # Snapshot evidence cursors the moment the FIRST signal turns
+        # anomalous (pre-hysteresis) so the correlation interval covers
+        # the whole excursion, not just the post-trip tail.
+        if any_first_anom and self._anom_cursors is None:
+            self._anom_cursors = dict(self._cursors)
+        opened = None
+        if tripped and self._open_incident is None:
+            opened = self._open(step, tripped)
+        elif self._open_incident is not None:
+            inc = self._open_incident
+            if tripped:
+                # New signals join the open incident; severity escalates.
+                for name in tripped:
+                    if name not in inc.signals:
+                        inc.signals[name] = self._signal_detail(name)
+                        if self._detectors[name].spec.kind == COUNTER:
+                            inc.severity = CRITICAL
+            elif all(not d.tripped for d in self._detectors.values()):
+                self._close(inc, step)
+        if self._open_incident is None and not any(
+                d.anom_streak for d in self._detectors.values()):
+            self._anom_cursors = None
+        self._cursors = self._read_cursors()
+        return opened
+
+    def _signal_detail(self, name: str) -> dict:
+        det = self._detectors[name]
+        base = [x for x in det.baseline]
+        return {
+            "kind": det.spec.kind,
+            "value": round(det.peak_value, 9),
+            "baseline": round(_median(base), 9) if base else 0.0,
+            "deviation": round(det.peak_dev, 9),
+            "first_anomaly_step": det.first_anom_step,
+        }
+
+    def _open(self, step: int, tripped: list[str]) -> Incident:
+        first = min(self._detectors[n].first_anom_step
+                    if self._detectors[n].first_anom_step is not None
+                    else step for n in tripped)
+        severity = CRITICAL if any(
+            self._detectors[n].spec.kind == COUNTER for n in tripped) \
+            else WARN
+        inc = Incident(
+            id=self.n_opened, kind="anomaly", severity=severity,
+            step_first_anomaly=first, step_open=step, replica=self.replica,
+            signals={n: self._signal_detail(n) for n in sorted(tripped)})
+        inc.suspects = self._triage(inc)
+        self._push(inc)
+        self._open_incident = inc
+        return inc
+
+    def _close(self, inc: Incident, step: int) -> None:
+        inc.step_closed = step
+        # Re-triage at close: evidence that arrived while the incident was
+        # open (late quarantines, knob responses) joins the verdict.
+        inc.suspects = self._triage(inc)
+        self._open_incident = None
+        self._anom_cursors = None
+        self.n_closed += 1
+
+    def _push(self, inc: Incident) -> None:
+        if len(self.incidents) == self.max_incidents:
+            self.n_evicted += 1
+        self.incidents.append(inc)
+        self.n_opened += 1
+
+    # -- SLO / watchdog integration ---------------------------------------
+
+    def on_slo_breach(self, objective: str, detail: dict | None = None,
+                      forensic: dict | None = None) -> Incident:
+        """A transition INTO BREACH opens a CRITICAL incident immediately
+        (no hysteresis — the SLO engine already burned its own fast/slow
+        windows getting here), wrapping a compact summary of the forensic
+        bundle the watchdog snapshotted."""
+        step = max(0, self.n_steps - 1)
+        inc = Incident(
+            id=self.n_opened, kind="slo-breach", severity=CRITICAL,
+            step_first_anomaly=step, step_open=step, replica=self.replica,
+            signals={f"slo:{objective}": {
+                "kind": "slo", "value": 2.0, "baseline": 0.0,
+                "deviation": 2.0, "first_anomaly_step": step,
+                **({"detail": {k: round(float(v["value"]), 9)
+                               for k, v in detail.items()
+                               if isinstance(v, dict) and "value" in v}}
+                   if detail else {}),
+            }})
+        if forensic is not None:
+            inc.forensic = _forensic_summary(forensic)
+        inc.suspects = self._triage(inc)
+        self._push(inc)
+        if self._open_incident is None:
+            self._open_incident = inc
+        return inc
+
+    # -- triage ------------------------------------------------------------
+
+    def _triage(self, inc: Incident) -> list[dict]:
+        """Correlate the incident interval against every attached evidence
+        stream and emit the ranked suspect list. Pure function of the
+        evidence contents — scores round to 6 decimals and ties break on
+        the suspect name, so the ranking is byte-stable."""
+        cur = self._anom_cursors or self._cursors
+        suspects: dict[str, dict] = {}
+
+        def bump(site: str, kind: str, score: float, **ev):
+            s = suspects.get(site)
+            if s is None:
+                s = suspects[site] = {"site": site, "kind": kind,
+                                      "score": 0.0, "evidence": {}}
+            s["score"] += score
+            for k, v in ev.items():
+                s["evidence"][k] = s["evidence"].get(k, 0) + v
+
+        counter_hit = any(d.get("kind") == COUNTER
+                          for d in inc.signals.values())
+        latency_hit = any(d.get("kind") == LEVEL
+                          for d in inc.signals.values())
+        if self.fault_log_source is not None:
+            events = list(self.fault_log_source() or ())
+            fresh = events[cur.get("faults", 0):]
+            by_site: dict[tuple[str, str], int] = {}
+            for ev in fresh:
+                by_site[(ev.site, ev.kind)] = \
+                    by_site.get((ev.site, ev.kind), 0) + 1
+            for (site, kind), n in by_site.items():
+                score = _W_FAULT + min(n, 10) * 0.1
+                # Kind/symptom agreement: delays push latency signals,
+                # nan/error push the failure counters.
+                if kind == "delay" and latency_hit:
+                    score += 2.0
+                if kind in ("nan", "error") and counter_hit:
+                    score += 2.0
+                bump(site, f"fault:{kind}", score, fires=n)
+        if self.blackbox_source is not None:
+            _, events = self.blackbox_source()
+            fresh = [e for e in events
+                     if e.get("seq", 0) >= cur.get("blackbox", 0)]
+            for bkind, weight in (("quarantine", _W_QUARANTINE),
+                                  ("fault", _W_QUARANTINE * 0.5),
+                                  ("backpressure", 1.0),
+                                  ("preempt", 0.5)):
+                hits = [e for e in fresh if e.get("kind") == bkind]
+                if hits:
+                    site = f"engine.{bkind}"
+                    bump(site, "blackbox", weight + min(len(hits), 10) * 0.1,
+                         events=len(hits))
+        if self.slo_source is not None:
+            trans = list(self.slo_source() or ())
+            fresh = trans[cur.get("slo", 0):]
+            for t in fresh:
+                if t.get("new") in ("WARN", "BREACH"):
+                    bump(f"slo.{t.get('objective', '?')}", "slo",
+                         _W_SLO if t["new"] == "BREACH" else 1.0,
+                         transitions=1)
+        if self.comm_source is not None:
+            snap = self.comm_source() or {}
+            worst_site, worst = None, 0.0
+            for site, row in sorted(snap.items()):
+                r = row.get("achieved_over_est")
+                if r is not None and r > max(worst, 2.0):
+                    worst_site, worst = site, r
+            if worst_site is not None:
+                bump(f"comm.{worst_site}", "comm",
+                     _W_COMM + min(worst, 10.0) * 0.1,
+                     achieved_over_est=round(worst, 6))
+        if self.efficiency_source is not None:
+            rows = list(self.efficiency_source() or ())
+            overlap = [r for r in rows
+                       if r.get("step", -1) >= inc.step_first_anomaly]
+            if overlap:
+                bump("host.bubble", "efficiency",
+                     _W_BUBBLE + min(len(overlap), 8) * 0.1,
+                     worst_steps=len(overlap))
+        if self.controller_source is not None:
+            actions = list(self.controller_source() or ())
+            fresh = actions[cur.get("controller", 0):]
+            by_knob: dict[str, int] = {}
+            for a in fresh:
+                by_knob[a.get("knob", "?")] = \
+                    by_knob.get(a.get("knob", "?"), 0) + 1
+            for knob, n in sorted(by_knob.items()):
+                bump(f"controller.{knob}", "controller",
+                     _W_CONTROLLER + min(n, 10) * 0.05, actions=n)
+        ranked = sorted(suspects.values(),
+                        key=lambda s: (-s["score"], s["site"]))
+        sig_names = ", ".join(sorted(inc.signals))
+        for s in ranked:
+            s["score"] = round(s["score"], 6)
+            s["chain"] = (f"{s['site']} {s['kind']} -> "
+                          f"{sig_names or 'slo'} -> {inc.severity}")
+        return ranked[:8]
+
+    # -- journeys as exemplars (attached post-hoc to reports) --------------
+
+    def exemplars(self, n: int = 4) -> list[dict]:
+        """Tail journey exemplars for the postmortem report (empty when no
+        journey source is wired)."""
+        if self.journey_source is None:
+            return []
+        rows = list(self.journey_source() or ())
+        return rows[:n]
+
+    # -- surfaces ----------------------------------------------------------
+
+    @property
+    def n_open(self) -> int:
+        return sum(1 for inc in self.incidents if inc.open)
+
+    def worst_severity_level(self) -> int:
+        return max((_SEV_LEVEL[inc.severity] for inc in self.incidents
+                    if inc.open), default=0)
+
+    def max_detect_latency_steps(self) -> int:
+        return max((inc.detect_latency_steps for inc in self.incidents),
+                   default=0)
+
+    def stats(self) -> dict:
+        """The ``stats_snapshot()['incidents']`` block."""
+        return {
+            "open": self.n_open,
+            "total": self.n_opened,
+            "closed": self.n_closed,
+            "evicted": self.n_evicted,
+            "steps": self.n_steps,
+            "severity_level": self.worst_severity_level(),
+            "detect_latency_steps": self.max_detect_latency_steps(),
+            "ring": [inc.as_dict() for inc in list(self.incidents)[-8:]],
+        }
+
+    def dump(self) -> dict:
+        """Full bounded history (the postmortem CLI's journal shape)."""
+        return {
+            "replica": self.replica,
+            "steps": self.n_steps,
+            "opened": self.n_opened,
+            "closed": self.n_closed,
+            "evicted": self.n_evicted,
+            "incidents": [inc.as_dict() for inc in self.incidents],
+        }
+
+    def perfdb_sample(self) -> dict:
+        """Flat lower-better keys for the perf flight recorder."""
+        return {
+            "incidents_open": float(self.n_open),
+            "incidents_total": float(self.n_opened),
+            "detect_latency_steps": float(self.max_detect_latency_steps()),
+        }
+
+    # -- cross-replica merge ----------------------------------------------
+
+    @staticmethod
+    def merge(dumps: dict) -> dict:
+        """Fleet rollup: merge per-replica ``dump()``s. Incidents whose
+        step windows OVERLAP (fleet replicas step in lockstep, so engine
+        step ordinals are comparable) collapse into one fleet incident —
+        a replica kill that trips three replicas' detectors in the same
+        window is ONE event. Suspect scores sum by site and re-rank."""
+        rows = []
+        for idx in sorted(dumps):
+            d = dumps[idx]
+            for inc in d.get("incidents", ()):
+                rows.append((idx, inc))
+        rows.sort(key=lambda r: (r[1]["step_first_anomaly"],
+                                 r[1]["step_open"], r[0]))
+        merged: list[dict] = []
+        for idx, inc in rows:
+            end = inc["step_closed"]
+            tgt = None
+            for g in merged:
+                g_end = g["step_closed"]
+                # Overlap test on [first_anomaly, closed-or-open-end].
+                if (inc["step_first_anomaly"]
+                        <= (g_end if g_end is not None else 1 << 60)
+                        and g["step_first_anomaly"]
+                        <= (end if end is not None else 1 << 60)):
+                    tgt = g
+                    break
+            # Negative idx = the fleet-level engine (fleet-only counters).
+            pre = "fleet" if idx < 0 else f"r{idx}"
+            if tgt is None:
+                g = dict(inc)
+                g["replicas"] = [idx]
+                g["signals"] = {f"{pre}:{k}": v
+                                for k, v in inc["signals"].items()}
+                g["suspects"] = [dict(s) for s in inc["suspects"]]
+                g.pop("replica", None)
+                merged.append(g)
+                continue
+            if idx not in tgt["replicas"]:
+                tgt["replicas"].append(idx)
+            tgt["step_first_anomaly"] = min(tgt["step_first_anomaly"],
+                                            inc["step_first_anomaly"])
+            tgt["step_open"] = min(tgt["step_open"], inc["step_open"])
+            if tgt["step_closed"] is None or end is None:
+                tgt["step_closed"] = None
+                tgt["state"] = "open"
+            else:
+                tgt["step_closed"] = max(tgt["step_closed"], end)
+            if _SEV_LEVEL.get(inc["severity"], 0) \
+                    > _SEV_LEVEL.get(tgt["severity"], 0):
+                tgt["severity"] = inc["severity"]
+            for k, v in inc["signals"].items():
+                tgt["signals"][f"{pre}:{k}"] = v
+            by_site = {s["site"]: s for s in tgt["suspects"]}
+            for s in inc["suspects"]:
+                t = by_site.get(s["site"])
+                if t is None:
+                    by_site[s["site"]] = dict(s)
+                else:
+                    t["score"] = round(t["score"] + s["score"], 6)
+                    for k, v in s.get("evidence", {}).items():
+                        t["evidence"][k] = t["evidence"].get(k, 0) + v
+            tgt["suspects"] = sorted(by_site.values(),
+                                     key=lambda s: (-s["score"], s["site"]))
+        open_n = sum(1 for g in merged if g["step_closed"] is None)
+        return {
+            "open": open_n,
+            "total": len(merged),
+            "replica_incidents": sum(
+                d.get("opened", 0) for d in dumps.values()),
+            "detect_latency_steps": max(
+                (g["detect_latency_steps"] for g in merged), default=0),
+            "severity_level": max(
+                (_SEV_LEVEL.get(g["severity"], 0) for g in merged
+                 if g["step_closed"] is None), default=0),
+            "ring": merged[-8:],
+        }
+
+
+def _forensic_summary(snap: dict) -> dict:
+    """Compact, bounded summary of a ``resilience_snapshot()`` bundle —
+    the incident ring must stay small, so the full dump never lands in
+    it, just the shape an operator needs to decide which CLI to open."""
+    out: dict = {}
+    if "in_flight" in snap:
+        out["in_flight"] = len(snap["in_flight"])
+    if "queue_depth" in snap:
+        out["queue_depth"] = snap["queue_depth"]
+    if "requests" in snap:
+        out["requests"] = dict(snap["requests"])
+    if "faults_fired" in snap:
+        out["faults_fired"] = snap["faults_fired"]
+    bb = snap.get("blackbox")
+    if isinstance(bb, dict):
+        kinds: dict[str, int] = {}
+        for ev in bb.get("events", ()):
+            k = ev.get("kind", "?")
+            kinds[k] = kinds.get(k, 0) + 1
+        out["blackbox_kinds"] = kinds
+    slo = snap.get("slo")
+    if isinstance(slo, dict) and "states" in slo:
+        out["slo_states"] = dict(slo["states"])
+    return out

@@ -144,31 +144,103 @@ class Histogram:
             yield le, cum
 
 
+def _key_escape(v) -> str:
+    """Escape a label value for the internal flat key: the structural
+    characters (``,`` ``}`` ``=``), newline, and backslash itself —
+    without this a value like ``a,b=c`` would make the flat key ambiguous
+    and unsplittable."""
+    return (str(v).replace("\\", "\\\\").replace(",", "\\,")
+            .replace("}", "\\}").replace("=", "\\=").replace("\n", "\\n"))
+
+
 def _series_key(name: str, labels: dict | None) -> str:
     """Flat series name: ``name`` or ``name{k=v,...}`` (keys sorted, so one
-    label set is one series regardless of dict order)."""
+    label set is one series regardless of dict order; structural chars in
+    values backslash-escaped)."""
     if not labels:
         return name
-    inner = ",".join(f"{k}={labels[k]}" for k in sorted(labels))
+    inner = ",".join(f"{k}={_key_escape(labels[k])}" for k in sorted(labels))
     return f"{name}{{{inner}}}"
 
 
-_SERIES_RE = re.compile(r"^(?P<name>[^{]+)(?:\{(?P<labels>.*)\})?$")
 _PROM_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
 
 
-def _split_series(key: str) -> tuple[str, dict]:
-    m = _SERIES_RE.match(key)
-    labels = {}
-    if m.group("labels"):
-        for part in m.group("labels").split(","):
-            k, _, v = part.partition("=")
-            labels[k] = v
-    return m.group("name"), labels
+def _split_label_body(body: str, *, quoted: bool) -> dict:
+    """Parse a label body into a dict.
+
+    ``quoted=True`` is the exposition-format side: values are
+    ``"``-delimited with 0.0.4 escapes (``\\\\``, ``\\"``, ``\\n``), and
+    commas/braces inside quotes do not split. ``quoted=False`` is the
+    internal ``_series_key`` side: values are bare with the structural
+    escapes ``_key_escape`` writes. The two formats are ambiguous to one
+    parser (a RAW value may start with ``"``), so the caller must say
+    which side it is reading."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(body)
+    while i < n:
+        j = body.find("=", i)
+        if j < 0:
+            break
+        k = body[i:j]
+        i = j + 1
+        if quoted and i < n and body[i] == '"':
+            i += 1
+            buf = []
+            while i < n:
+                c = body[i]
+                if c == "\\" and i + 1 < n:
+                    nxt = body[i + 1]
+                    buf.append({"n": "\n", '"': '"', "\\": "\\"}
+                               .get(nxt, "\\" + nxt))
+                    i += 2
+                    continue
+                if c == '"':
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+            labels[k] = "".join(buf)
+            if i < n and body[i] == ",":
+                i += 1
+        else:
+            buf = []
+            while i < n:
+                c = body[i]
+                if c == "\\" and i + 1 < n:
+                    nxt = body[i + 1]
+                    buf.append({"n": "\n", ",": ",", "}": "}", "=": "=",
+                                "\\": "\\"}.get(nxt, "\\" + nxt))
+                    i += 2
+                    continue
+                if c == ",":
+                    i += 1
+                    break
+                buf.append(c)
+                i += 1
+            labels[k] = "".join(buf)
+    return labels
+
+
+def _split_series(key: str, *, quoted: bool = False) -> tuple[str, dict]:
+    # Not a regex: internal series keys carry RAW label values, which may
+    # contain newlines `.`/`$` can't span.
+    i = key.find("{")
+    if i < 0 or not key.endswith("}"):
+        return key, {}
+    return key[:i], _split_label_body(key[i + 1:-1], quoted=quoted)
 
 
 def _prom_name(name: str) -> str:
     return _PROM_NAME_RE.sub("_", name)
+
+
+def _prom_escape(v) -> str:
+    """Escape one label VALUE per exposition format 0.0.4: backslash,
+    double-quote, and newline (in that order — backslash first so the
+    others don't double-escape)."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
 
 
 def _prom_labels(labels: dict, extra: dict | None = None) -> str:
@@ -177,7 +249,7 @@ def _prom_labels(labels: dict, extra: dict | None = None) -> str:
         merged.update(extra)
     if not merged:
         return ""
-    inner = ",".join(f'{_prom_name(k)}="{v}"' for k, v in
+    inner = ",".join(f'{_prom_name(k)}="{_prom_escape(v)}"' for k, v in
                      sorted(merged.items()))
     return "{" + inner + "}"
 
@@ -418,9 +490,34 @@ def parse_prometheus(text: str) -> dict[str, float]:
         line = line.strip()
         if not line or line.startswith("#"):
             continue
-        series, _, value = line.rpartition(" ")
-        name, labels = _split_series(series)
-        # Normalize quoted label values + ordering to the _series_key form.
-        labels = {k: v.strip('"') for k, v in labels.items()}
+        series, value = _split_exposition_line(line)
+        name, labels = _split_series(series, quoted=True)
         out[_series_key(name, labels)] = float(value)
     return out
+
+
+def _split_exposition_line(line: str) -> tuple[str, str]:
+    """Split one sample line into (series, value). The value is whatever
+    follows the label block's CLOSING brace — found with a quote-aware
+    scan, because escaped label values may contain spaces, commas, and
+    ``}`` that a naive ``rpartition(" ")`` would split on."""
+    i = line.find("{")
+    if i < 0:
+        series, _, value = line.rpartition(" ")
+        return series, value
+    j, n = i + 1, len(line)
+    in_quote = False
+    while j < n:
+        c = line[j]
+        if in_quote:
+            if c == "\\":
+                j += 2
+                continue
+            if c == '"':
+                in_quote = False
+        elif c == '"':
+            in_quote = True
+        elif c == "}":
+            break
+        j += 1
+    return line[:j + 1], line[j + 1:].strip()

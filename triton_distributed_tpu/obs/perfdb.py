@@ -223,7 +223,11 @@ def upper_quartile(xs: list[float]) -> float:
 
 _LOWER_BETTER_HINTS = ("latency", "ttft", "tbt", "wall", "preemption",
                        "retrace", "_failed", "achieved_over_bound",
-                       "queue_wait", "_ms_", "_error")
+                       "queue_wait", "_ms_", "_error",
+                       # Telemetry drops (trace spans, blackbox events,
+                       # journey events), faults the control plane ate,
+                       # and dead replicas are all pure costs.
+                       "drop", "fault", "_dead")
 # Checked BEFORE the higher-better hints: names the generic hints would
 # misread. "bytes_ratio" (bench --paged-attn: fused/gather HBM traffic)
 # contains "ratio" but fewer bytes win — without the override the gate
@@ -251,7 +255,13 @@ _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            # "reversal" (speculative-k direction flips:
                            # the adaptive controller changing its mind)
                            # is flap, same as knob oscillation.
-                           "oscillation", "bubble", "reversal")
+                           # "incident" (incident engine: open/total
+                           # anomaly-incident counts — detected service
+                           # regressions, strictly a cost; zero on a
+                           # clean trace). "detect_latency_steps" rides
+                           # the "latency" hint already.
+                           "oscillation", "bubble", "reversal",
+                           "incident")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
@@ -262,6 +272,37 @@ _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         # verified — more free tokens per step.
                         "hit_rate", "mfu", "mbu", "accept_rate")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
+
+# Metrics recorded for CONTEXT, consciously ungated: workload-scaled
+# counts (requests, steps, tokens proposed/accepted), configuration
+# echoes (chunk sizes, replica counts), and exercise witnesses the smoke
+# scripts assert on directly. metric_direction() returns 0 for these and
+# the gate reports them informationally — which is correct, a bigger
+# workload is not a regression. The list exists so
+# tools/check_perfdb_directions.py can tell "declared neutral" from
+# "nobody thought about the direction": every NEW recorded key must
+# either carry a direction hint above or be added here on purpose.
+NEUTRAL_CONTEXT = frozenset({
+    # bench arm context
+    "paged_attn_prefill_chunk", "paged_attn_roofline_class", "probe_steps",
+    "serve_prefix_requests", "serve_prefix_evictions", "slo_evaluations",
+    "journey_finished", "journey_kept", "journey_chrome_rows",
+    "eff_steps", "tenant_count", "inc_steps", "inc_signals",
+    "adaptive_requests", "adaptive_slo_met", "adaptive_chat_met",
+    "adaptive_doc_met", "warn_steps", "controller_actions",
+    "spec_requests", "spec_slo_met", "spec_proposed_tokens",
+    "spec_accepted_tokens", "spec_rollback_tokens", "spec_k_grows",
+    "spec_k_shrinks", "spec_steps_adaptive", "spec_steps_k0",
+    # library perfdb_sample() context
+    "pool_free_blocks", "pool_largest_free_run", "pool_cached_blocks",
+    "pruned_configs", "controller_revives", "n_replicas",
+    "requests_submitted", "warn_transitions",
+})
+
+
+def is_neutral_context(name: str) -> bool:
+    """True for metrics DECLARED context-only (ungated on purpose)."""
+    return name in NEUTRAL_CONTEXT
 
 # Overhead fractions measure a cost RATIO bounded near zero, so the
 # contract is the absolute budget (the bench arms enforce <= 5% where

@@ -341,11 +341,26 @@ def tracing(capacity: int | None = None):
 def merge_chrome_traces(dir: str, out_name: str = "trace.merged.json") -> str:
     """Concatenate every ``trace.p*.json`` under ``dir`` into one Chrome
     trace (events already carry distinct pids) — the reference's manual
-    per-rank chrome-trace merge, as one call."""
+    per-rank chrome-trace merge, as one call.
+
+    ``ph:"M"`` process/thread metadata events (process_name, thread_name,
+    sort indices) are deduplicated by (name, pid, tid, args): one rank
+    contributing host + device + journey rows repeats the same metadata
+    in each file, and Perfetto renders the duplicates as ghost tracks.
+    First occurrence wins; non-metadata events pass through untouched and
+    in file order."""
     events: list[dict] = []
+    seen_meta: set = set()
     for path in sorted(glob.glob(os.path.join(dir, "trace.p*.json"))):
         with open(path) as f:
-            events.extend(json.load(f).get("traceEvents", []))
+            for ev in json.load(f).get("traceEvents", []):
+                if ev.get("ph") == "M":
+                    key = (ev.get("name"), ev.get("pid"), ev.get("tid"),
+                           json.dumps(ev.get("args", {}), sort_keys=True))
+                    if key in seen_meta:
+                        continue
+                    seen_meta.add(key)
+                events.append(ev)
     out = os.path.join(dir, out_name)
     with open(out, "w") as f:
         json.dump({"traceEvents": events, "displayTimeUnit": "ms"}, f)

@@ -58,6 +58,7 @@ from triton_distributed_tpu.obs import comm_ledger as _comm
 from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.obs.blackbox import Blackbox
 from triton_distributed_tpu.obs.efficiency import EfficiencyLedger
+from triton_distributed_tpu.obs.incident import IncidentEngine
 from triton_distributed_tpu.obs.journey import JourneyRecorder
 from triton_distributed_tpu.obs.slo import (
     BREACH,
@@ -166,6 +167,14 @@ class BatchEngine:
                    speculation requires ``temperature == 0.0``.
     """
 
+    # Driven-continuity parameters for the incident engine's efficiency-
+    # trio signals: a tick gap beyond _INC_GAP_S (or an idle step) marks
+    # the engine not-continuously-driven, and the trio stays suppressed
+    # until _INC_WINDOW_S of uninterrupted busy ticks refill the rolling
+    # window (matches the 10 s windowed reads in _incident_tick).
+    _INC_GAP_S = 0.5
+    _INC_WINDOW_S = 10.0
+
     def __init__(self, engine: Engine, *, n_slots: int = 8,
                  n_blocks: int | None = None, block_size: int = 16,
                  prefill_chunk: int = 32, max_seq_len: int | None = None,
@@ -177,6 +186,7 @@ class BatchEngine:
                  tail_sampling: bool | TailSampler = True,
                  journey: bool | JourneyRecorder = True,
                  efficiency: bool | EfficiencyLedger = True,
+                 incidents: bool | IncidentEngine = True,
                  speculative=False):
         if paged_attn not in ("fused", "gather"):
             raise ValueError(
@@ -244,6 +254,26 @@ class BatchEngine:
             self.efficiency = EfficiencyLedger()
         else:
             self.efficiency = None
+        # Incident engine (obs/incident.py): deterministic online anomaly
+        # detectors over the live signal set, with cross-layer triage into
+        # a ranked suspect list when one trips. Step-paced (its observe
+        # ordinal is the clock) and host-side only — same trace, same
+        # incidents, trace_counts untouched.
+        if isinstance(incidents, IncidentEngine):
+            self.incidents = incidents
+        elif incidents:
+            self.incidents = IncidentEngine()
+        else:
+            self.incidents = None
+        # Bounded SLO transition log the incident triage reads (cursor-
+        # indexed, so a plain append-only list — transitions are rare).
+        self._slo_transition_log: list[dict] = []
+        # Driven-continuity tracking for the efficiency-trio signals: the
+        # tick before the first, after an idle step, or after an external
+        # pause marks the engine not-continuously-driven (see
+        # _incident_tick).
+        self._inc_last_tick: float | None = None
+        self._inc_idle_mark = 0.0
         # KV dtype width feeding step_hbm_bytes (tiny test configs run
         # f32; real configs bf16).
         self._eff_itemsize = int(jnp.dtype(engine.config.dtype).itemsize)
@@ -282,6 +312,8 @@ class BatchEngine:
         # Per-step draft proposals, slot index -> token list; rebuilt by
         # ``step()`` every iteration (never carried across steps).
         self._proposals: dict[int, list[int]] = {}
+        if self.incidents is not None:
+            self._wire_incident_sources(self.incidents)
         self._build_steps()
 
     # -- compiled steps -----------------------------------------------------
@@ -438,12 +470,21 @@ class BatchEngine:
         if self.journey is not None:
             self.journey.global_event("slo", objective=obj.name, old=old,
                                       new=new)
+        self._slo_transition_log.append(
+            {"objective": obj.name, "old": old, "new": new})
         if new == BREACH:
             self.metrics.inc("slo_breaches")
             if self._watchdog is not None:
                 self._watchdog.snapshot(
                     f"slo-breach:{obj.name}",
                     extra={"slo_detail": detail})
+            if self.incidents is not None:
+                # A breach IS an incident — open it immediately (the SLO
+                # engine already burned its windows getting here) wrapping
+                # a compact summary of the same forensic bundle the
+                # watchdog snapshot carries.
+                self.incidents.on_slo_breach(
+                    obj.name, detail, forensic=self.resilience_snapshot())
 
     def stream_stats(self, path: str, *, interval_s: float = 1.0) -> None:
         """Append one ``stats_snapshot()`` JSON line to ``path`` every
@@ -469,6 +510,84 @@ class BatchEngine:
             with open(self._stats_stream, "a") as f:
                 f.write(json.dumps(self.stats_snapshot(), default=str)
                         + "\n")
+
+    def _wire_incident_sources(self, inc: IncidentEngine) -> None:
+        """Hand the incident engine its cross-layer evidence feeds as
+        zero-arg callables. Everything resolves through ``self`` lazily —
+        the controller and watchdog attach after construction, and the
+        fault plane is a context-scoped module global — and everything is
+        polled only when an incident actually trips (triage time), never
+        per step."""
+        inc.fault_log_source = lambda: (
+            p.log if (p := _faults.get_plan()) is not None else ())
+        if self.blackbox is not None:
+            inc.blackbox_source = lambda: (
+                self.blackbox.n_recorded,
+                self.blackbox.dump(last=64)["events"])
+        inc.controller_source = lambda: (
+            self._controller.action_log
+            if self._controller is not None else ())
+        inc.slo_source = lambda: self._slo_transition_log
+        if self.efficiency is not None:
+            inc.efficiency_source = lambda: (
+                self.efficiency.stats()["worst_bubble"])
+        if self.journey is not None:
+            inc.journey_source = lambda: (
+                self.journey.stats().get("slowest", ()))
+        inc.comm_source = lambda: (
+            _comm.snapshot() if _comm.enabled() else {})
+
+    def _incident_tick(self, busy: bool = True) -> None:
+        """Feed the incident engine one step's signal bundle. Absent
+        subsystems simply never feed their signal — the detectors skip
+        missing keys. Windowed reads stay cheap (bucket-count merges, no
+        sample storage); the bench --serve --incidents arm gates the total
+        under 5% of step time.
+
+        The efficiency trio (mfu/mbu/bubble_frac) is fed only after the
+        engine has been CONTINUOUSLY driven for a full window: the ledger
+        bills any external pause (idle polling, a caller that stopped
+        stepping, bench interleaving) to the next step's bubble, and the
+        rolling window then reads ~the gap fraction for a further 10 s —
+        a driving-pattern artifact, not a host pathology. Genuine host
+        stalls accumulate as many sub-threshold per-step gaps and still
+        feed through; the sample-based latency quantiles and the failure
+        counters are immune and stay always-on."""
+        inc = self.incidents
+        if inc is None:
+            return
+        now = time.monotonic()
+        prev = self._inc_last_tick
+        self._inc_last_tick = now
+        if not busy or prev is None or now - prev > self._INC_GAP_S:
+            self._inc_idle_mark = now
+        driven = now - self._inc_idle_mark >= self._INC_WINDOW_S
+        sig: dict = {}
+        if self.metrics.windowed:
+            for series, name in (("tbt_s", "tbt_p99_s"),
+                                 ("queue_wait_s", "queue_wait_p99_s")):
+                ws = self.metrics.window_stats(series, 10.0)
+                if ws is not None and ws.count:
+                    sig[name] = ws.quantile(99)
+            ws = self.metrics.window_stats("spec_accept_ratio", 10.0)
+            if ws is not None and ws.count:
+                sig["accept_rate"] = ws.mean
+        eff = self.efficiency
+        if eff is not None and eff.steps and driven:
+            mfu, mbu = eff.mfu(10.0), eff.mbu(10.0)
+            if mfu or mbu:      # window has accounted steps
+                sig["mfu"] = mfu
+                sig["mbu"] = mbu
+                sig["bubble_frac"] = eff.bubble_frac(10.0)
+        if _comm.enabled():
+            snap = _comm.snapshot()
+            ratios = [row["achieved_over_est"] for row in snap.values()
+                      if row.get("achieved_over_est") is not None]
+            if ratios:
+                sig["achieved_over_est"] = max(ratios)
+        sig["requests_failed"] = self.metrics.counters.get(
+            "requests_failed", 0.0)
+        inc.observe(sig)
 
     def _window_summary(self) -> dict:
         """Trailing-window latency stats over the snapshot windows (empty
@@ -532,6 +651,8 @@ class BatchEngine:
             snap["journey"] = self.journey.stats()
         if self.efficiency is not None:
             snap["efficiency"] = self.efficiency.stats()
+        if self.incidents is not None:
+            snap["incidents"] = self.incidents.stats()
         if self.spec is not None:
             blk = {"drafter": self.spec.name,
                    **self.spec.controller.stats()}
@@ -590,6 +711,8 @@ class BatchEngine:
             out["journey"] = self.journey.dump()
         if self.efficiency is not None:
             out["efficiency"] = self.efficiency.dump()
+        if self.incidents is not None:
+            out["incidents"] = self.incidents.dump()
         return out
 
     def perfdb_sample(self) -> dict:
@@ -626,6 +749,8 @@ class BatchEngine:
             out.update(self._controller.perfdb_sample())
         if self.efficiency is not None and self.efficiency.steps:
             out.update(self.efficiency.perfdb_sample())
+        if self.incidents is not None:
+            out.update(self.incidents.perfdb_sample())
         # Pool fragmentation (KVPool.fragmentation): lets block-size sweeps
         # in the run DB separate allocator shredding from kernel effects.
         frag = self.pool.fragmentation()
@@ -1219,8 +1344,10 @@ class BatchEngine:
                                self.pool.n_used / self.pool.n_blocks)
         # SLO evaluation + stats stream run even on idle iterations — an
         # engine starved by a fault is exactly when the SLO must keep
-        # evaluating.
+        # evaluating. Same for the incident detectors: a stall shows up
+        # as signals going quiet, not as a step that runs.
         self._obs_tick()
+        self._incident_tick(busy=bool(active))
         if self._controller is not None:
             self._controller.on_step()
         if not active:

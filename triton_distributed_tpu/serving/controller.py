@@ -231,11 +231,17 @@ class Controller:
         # dominates has its sensor ready. Lifetime ratio = pure function of
         # accumulated plant state — no clock read here.
         eff = getattr(eng, "efficiency", None)
+        # Open-incident count rides along the same way (obs/incident.py):
+        # the anomaly sentinel's verdict is in every action-log row's
+        # context, ready for a future "back off while an incident is
+        # open" law without changing today's decisions.
+        inc = getattr(eng, "incidents", None)
         return {"queue": len(eng.scheduler), "decode_rows": decode,
                 "prefill_rows": prefill, "backlog_tokens": backlog,
                 "free_frac": eng.pool.headroom_frac,
                 "bubble_frac": (round(eff.lifetime_bubble_frac(), 6)
                                 if eff is not None else 0.0),
+                "incidents_open": (inc.n_open if inc is not None else 0),
                 "level": (eng.slo.worst_level()
                           if eng.slo is not None else 0)}
 
@@ -250,7 +256,7 @@ class Controller:
         if self.fleet is not None:
             agg = {"queue": len(self.fleet._pending), "decode_rows": 0,
                    "prefill_rows": 0, "backlog_tokens": 0, "level": 0,
-                   "free": 0, "blocks": 0}
+                   "free": 0, "blocks": 0, "incidents_open": 0}
             from triton_distributed_tpu.serving.fleet import DEAD, ROUTABLE
             dead = []
             bubble_s = interval_s = 0.0
@@ -261,7 +267,7 @@ class Controller:
                     continue
                 o = self._engine_obs(rep.engine)
                 for k in ("queue", "decode_rows", "prefill_rows",
-                          "backlog_tokens"):
+                          "backlog_tokens", "incidents_open"):
                     agg[k] += o[k]
                 agg["level"] = max(agg["level"], rep.slo_level())
                 pool = rep.engine.pool
@@ -279,6 +285,9 @@ class Controller:
             # seconds (ratios never average across replicas).
             agg["bubble_frac"] = (round(bubble_s / interval_s, 6)
                                   if interval_s > 0 else 0.0)
+            fleet_inc = getattr(self.fleet, "incidents", None)
+            if fleet_inc is not None:
+                agg["incidents_open"] += fleet_inc.n_open
             agg["step"] = self.fleet.n_steps
             agg["dead"] = tuple(dead)
             return agg

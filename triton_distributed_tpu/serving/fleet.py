@@ -198,6 +198,25 @@ class Fleet:
                 rep.engine.journey = self.journey
         else:
             self.journey = None
+        # Fleet-level incident engine: watches the counters only the fleet
+        # sees (replica quarantines, requeue displacements, fleet-side
+        # terminal failures). Per-replica engines keep their own detectors
+        # and each gets its replica idx stamped so the merged view can
+        # tell who tripped; ``_incidents_block()`` rolls everything up.
+        if any(getattr(rep.engine, "incidents", None) is not None
+               for rep in self.replicas):
+            from triton_distributed_tpu.obs.incident import IncidentEngine
+            for rep in self.replicas:
+                if rep.engine.incidents is not None:
+                    rep.engine.incidents.replica = rep.idx
+            self.incidents = IncidentEngine(replica=-1)
+            self.incidents.fault_log_source = lambda: (
+                p.log if (p := _faults.get_plan()) is not None else ())
+            self.incidents.controller_source = lambda: (
+                self._controller.action_log
+                if self._controller is not None else ())
+        else:
+            self.incidents = None
 
     # -- construction -------------------------------------------------------
 
@@ -601,6 +620,13 @@ class Fleet:
         (fleet idle)."""
         self.n_steps += 1
         self._update_health()
+        if self.incidents is not None:
+            fm = self.metrics.as_dict()
+            self.incidents.observe({
+                "quarantines": fm.get("replica_quarantines", 0.0),
+                "requeues": fm.get("requeues", 0.0),
+                "requests_failed": fm.get("requests_failed", 0.0),
+            })
         if self._controller is not None:
             self._controller.on_step()
         moved = self._drain()
@@ -775,6 +801,8 @@ class Fleet:
             **({"efficiency": eff} if (eff := self._efficiency_block())
                else {}),
             **({"spec": spec} if (spec := self._spec_block()) else {}),
+            **({"incidents": inc} if (inc := self._incidents_block())
+               else {}),
         }
 
     def _spec_block(self) -> dict:
@@ -830,6 +858,21 @@ class Fleet:
             "worst_bubble": worst[:8],
         }
 
+    def _incidents_block(self) -> dict:
+        """Fleet-wide incident rollup: per-replica incident dumps (plus
+        the fleet-level engine's own, keyed -1) merged by overlapping step
+        windows — replicas step in lockstep, so one fault that trips three
+        replicas' detectors in the same window is ONE fleet incident."""
+        from triton_distributed_tpu.obs.incident import IncidentEngine
+        dumps = {rep.idx: rep.engine.incidents.dump()
+                 for rep in self.replicas
+                 if getattr(rep.engine, "incidents", None) is not None}
+        if self.incidents is not None:
+            dumps[-1] = self.incidents.dump()
+        if not dumps or not any(d["incidents"] for d in dumps.values()):
+            return {}
+        return IncidentEngine.merge(dumps)
+
     def perfdb_sample(self) -> dict:
         """Flat fleet metrics for the perf flight recorder — per-replica
         engine samples aggregate by SUM for counters; ``retraces`` sums so
@@ -840,14 +883,16 @@ class Fleet:
                 if (k.endswith("_ms") or k.startswith("pool_")
                         or k.startswith("journey_")
                         or k in ("mfu", "mbu", "bubble_frac",
-                                 "spec_accept_rate")
-                        or k.startswith(("tenant_", "eff_"))):
+                                 "spec_accept_rate", "detect_latency_steps")
+                        or k.startswith(("tenant_", "eff_", "incidents_"))):
                     # Latency/pool shape is per-replica; journey metrics
                     # come from ONE recorder shared by every replica, so
                     # summing would count the fleet N times (added once
                     # below). Efficiency RATIOS likewise never sum —
                     # fleet-level mfu/mbu/bubble_frac are recomputed from
                     # summed totals below; tenant tables merge there too.
+                    # Incident counts come back MERGED (same window across
+                    # replicas is one fleet incident) rather than summed.
                     continue
                 out[k] = out.get(k, 0.0) + float(v)
         if self.journey is not None:
@@ -875,6 +920,13 @@ class Fleet:
                   "fleet_backpressure", "requests_routed",
                   "replica_revives"):
             out[k] = float(fm.get(k, 0.0))
+        inc = self._incidents_block()
+        if inc or any(getattr(rep.engine, "incidents", None) is not None
+                      for rep in self.replicas):
+            out["incidents_open"] = float(inc.get("open", 0))
+            out["incidents_total"] = float(inc.get("total", 0))
+            out["detect_latency_steps"] = float(
+                inc.get("detect_latency_steps", 0))
         out["n_replicas"] = float(len(self.replicas))
         out["replicas_dead"] = float(sum(rep.state == DEAD
                                          for rep in self.replicas))
