@@ -1689,6 +1689,187 @@ def _bench_serve_spec() -> dict:
     }
 
 
+# --- crash-recovery arm (--serve --crash) ----------------------------------
+
+
+def _bench_serve_crash(seed: int = 0) -> dict:
+    """The ``--serve --crash`` arm: the kill-the-world recovery gate.
+
+    One golden fleet (never crashed) serves a churny speculative workload
+    to completion. The same workload then runs with the write-ahead
+    journal attached, checkpoints mid-flight, takes three more steps, and
+    dies (``journal.crash()`` — the buffered tail is lost exactly as a
+    power cut would lose it). ``Fleet.restore`` rebuilds onto fresh
+    replicas (compiled steps shared from the golden donor), and mid-
+    recovery the fleet also **spawns** one replica and **retires**
+    another — the elastic round-trip under load. Gates, all strict:
+    outputs bit-identical to golden for EVERY request (zero lost), zero
+    retraces anywhere, replay bounded by one full recompute of the trace,
+    and journaling overhead <= 5% (journal-on vs journal-off walls,
+    interleaved best-of-N so machine drift cancels)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.resilience import read_journal
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import Fleet
+
+    config = ModelConfig.from_name("tiny")
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    # The preemption-golden fleet shape: slots can outgrow the pool, so
+    # recovery has to replay through eviction churn, not a quiet trace.
+    kw = dict(n_replicas=2, n_slots=3, n_blocks=8, block_size=4,
+              prefill_chunk=8, fail_threshold=2, speculative=True)
+    rng = np.random.default_rng(seed)
+    specs = [(rng.integers(1, config.vocab_size,
+                           size=int(rng.integers(4, 9))).tolist(),
+              int(rng.integers(8, 13))) for _ in range(20)]
+
+    def build(donor=None):
+        fleet = Fleet.build(engine, **kw)
+        if donor is not None:
+            for rep in fleet.replicas:
+                rep.engine.share_steps_from(donor)
+        return fleet
+
+    def submit_all(fleet):
+        for i, (p, g) in enumerate(specs):
+            fleet.submit(p, g, req_id=f"r{i}")
+
+    def finish(fleet):
+        fleet.run(max_steps=5000)
+        if not fleet.check_invariants():
+            raise RuntimeError("fleet invariants violated")
+        if fleet.failed:
+            raise RuntimeError(
+                f"crash arm failed requests: {sorted(fleet.failed)}")
+        return {rid: list(r.output) for rid, r in fleet.finished.items()}
+
+    def retraces(fleet):
+        return sum(max(0, sum(rep.engine.trace_counts.values()) - 2)
+                   for rep in fleet.replicas)
+
+    workdir = tempfile.mkdtemp(prefix="tdt_crash_")
+    try:
+        # 1. Golden reference: never-crashed outputs + the compile donor.
+        golden = build()
+        submit_all(golden)
+        want = finish(golden)
+        if len(want) != len(specs):
+            raise RuntimeError(f"golden lost requests: {len(want)}")
+        donor = golden.replicas[0].engine
+        golden_steps = golden.n_steps
+
+        # 2. Journaling overhead: identical workload (doubled, so the
+        # per-request durable-submit fsyncs amortize over a long enough
+        # wall to measure), WAL on vs off, interleaved so drift cancels;
+        # best-of-N per arm (noise is one-sided — the min is the
+        # least-contended estimate).
+        def timed(journal_path):
+            fleet = build(donor)
+            if journal_path is not None:
+                fleet.attach_journal(journal_path)
+            t0 = time.perf_counter()
+            for rep_i in range(2):
+                for i, (p, g) in enumerate(specs):
+                    fleet.submit(p, g, req_id=f"t{rep_i}-{i}")
+            fleet.run(max_steps=5000)
+            dt = time.perf_counter() - t0
+            if len(fleet.finished) != 2 * len(specs):
+                raise RuntimeError("overhead trial lost requests")
+            if fleet.journal is not None:
+                fleet.journal.close()
+            return dt
+
+        on, off = [], []
+        for i in range(3):
+            off.append(timed(None))
+            on.append(timed(os.path.join(workdir, f"wal_t{i}.jsonl")))
+        overhead = max(0.0, min(on) / min(off) - 1.0)
+
+        # 3. Kill the world: journal on, checkpoint, 3 journal-only
+        # steps, power cut.
+        f1 = build(donor)
+        jpath = os.path.join(workdir, "wal.jsonl")
+        f1.attach_journal(jpath, fsync_every=4)
+        submit_all(f1)
+        crash_step = max(6, golden_steps // 3 + int(rng.integers(0, 5)))
+        ckpt_step = crash_step - 3
+        for _ in range(ckpt_step):
+            f1.step()
+        ck = os.path.join(workdir, "ckpt")
+        f1.checkpoint(ck)
+        for _ in range(3):
+            f1.step()
+        f1.journal.crash()
+        journal_records = len(read_journal(jpath).records)
+        del f1
+
+        # 4. Restore + elastic round-trip: spawn a replica and retire
+        # another while the recovered trace is still in flight.
+        t0 = time.perf_counter()
+        f2 = Fleet.restore(ck, engine, donor=donor, **kw)
+        recovery_s = time.perf_counter() - t0
+        for _ in range(3):
+            f2.step()
+        f2.spawn()
+        for _ in range(3):
+            f2.step()
+        f2.retire(0)
+        got = finish(f2)
+        replay_steps = f2.n_steps - ckpt_step
+
+        lost = len(specs) - len(got)
+        if lost or got != want:
+            bad = sorted(r for r in want if got.get(r) != want[r])
+            raise RuntimeError(
+                f"restore diverged from golden: lost={lost}, "
+                f"mismatched={bad[:4]}")
+        n_retraces = retraces(f2)
+        if n_retraces:
+            raise RuntimeError(f"recovery retraced: {n_retraces}")
+        # Replay is bounded: recovery never costs more than one full
+        # recompute of the trace (plus the spawn/retire churn slack).
+        if replay_steps > golden_steps + 16:
+            raise RuntimeError(
+                f"unbounded replay: {replay_steps} steps vs golden "
+                f"{golden_steps}")
+        if overhead > 0.05:
+            raise RuntimeError(
+                f"journaling overhead {overhead:.4f} exceeds 5% "
+                f"(on={min(on):.3f}s off={min(off):.3f}s)")
+        fm = f2.metrics.counters
+        extras = {
+            "crash_step": crash_step,
+            "crash_seed": seed,
+            "journal_records": journal_records,
+            "journal_overhead_frac": round(overhead, 4),
+            "replay_steps": replay_steps,
+            "recovery_s": round(recovery_s, 4),
+            "restored_requests": fm.get("restored_requests", 0.0),
+            "replica_spawns": fm.get("replica_spawns", 0.0),
+            "replica_retirements": fm.get("replica_retirements", 0.0),
+            "lost_requests": lost,
+            "crash_retraces": n_retraces,
+            "crash_bit_identical": True,
+        }
+        return {
+            "backend": jax.devices()[0].platform,
+            "metric": "journal_overhead_frac",
+            "value": round(overhead, 4),
+            "unit": "frac",
+            "extras": extras,
+        }
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def main():
     import sys
 
@@ -1750,7 +1931,9 @@ def main():
         with_efficiency = "--efficiency" in sys.argv
         with_incidents = "--incidents" in sys.argv
         with_spec = "--spec" in sys.argv
-        metric = ("spec_goodput_under_slo" if with_spec
+        with_crash = "--crash" in sys.argv
+        metric = ("journal_overhead_frac" if with_crash
+                  else "spec_goodput_under_slo" if with_spec
                   else "goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
                   else "journey_overhead_frac" if with_journey
@@ -1758,7 +1941,10 @@ def main():
                   else "incidents_overhead_frac" if with_incidents
                   else "prefix_hit_rate")
         try:
-            if with_spec:
+            if with_crash:
+                result = _bench_serve_crash(
+                    seed=int(_arg_after(sys.argv, "--crash-seed", 0)))
+            elif with_spec:
                 result = _bench_serve_spec()
             elif adaptive:
                 result = _bench_serve_adaptive()
@@ -1782,7 +1968,8 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path,
-                       suite=("serve_spec" if with_spec
+                       suite=("serve_crash" if with_crash
+                              else "serve_spec" if with_spec
                               else "serve_adaptive" if adaptive
                               else "serve_slo" if with_slo
                               else "serve_journey" if with_journey

@@ -30,6 +30,13 @@ NaN fault plan at ``engine.decode`` that must open >= 1 incident whose
 TOP-ranked suspect names the injected site with near-immediate detection
 latency (recall + attribution).
 
+``--restore`` runs the crash-recovery arm: Poisson load through a
+journaled fleet, a mid-flight checkpoint, a simulated power cut
+(``journal.crash()`` — the un-fsynced tail is lost), then
+``Fleet.restore`` onto fresh replicas sharing the dead fleet's compiled
+steps. FAILS unless zero requests are lost, at least one request
+finishes after the restore, and no replica retraces.
+
 ``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
 N replicas behind the cache/SLO-aware router. Plain run: everything
 completes, no replica leaves the ROUTABLE states, every replica's two
@@ -178,6 +185,126 @@ def main_fleet(duration_s: float = 30.0, *, rate_hz: float = 4.0,
                   "seed": seed, "n_replicas": n_replicas})
         m["perfdb_run_id"] = rec.run_id
     return m
+
+
+def main_restore(duration_s: float = 6.0, *, rate_hz: float = 6.0,
+                 n_replicas: int = 2, n_slots: int = 3,
+                 n_blocks: int = 10, seed: int = 0,
+                 perfdb_path: str | None = None) -> dict:
+    """The ``--restore`` arm: checkpoint / crash / restore under Poisson
+    load. Phase 1 submits open-loop arrivals through a journaled fleet,
+    checkpoints mid-flight, takes a few more journal-only steps, and
+    dies (``journal.crash()`` — the un-fsynced tail is lost exactly as a
+    power cut would lose it). ``Fleet.restore`` then rebuilds onto fresh
+    replicas (compiled steps shared from the dead fleet's engine — no
+    retrace) and drains. FAILS unless ZERO submitted requests are lost
+    (every one finishes, none failed), at least one request finishes
+    AFTER the restore, and no replica ever retraces."""
+    import os
+    import shutil
+    import tempfile
+
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import Fleet
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny")
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    kw = dict(n_replicas=n_replicas, n_slots=n_slots, n_blocks=n_blocks,
+              block_size=4, prefill_chunk=8, fail_threshold=2)
+    fleet = Fleet.build(engine, **kw)
+    workdir = tempfile.mkdtemp(prefix="tdt_smoke_restore_")
+    try:
+        jpath = os.path.join(workdir, "wal.jsonl")
+        fleet.attach_journal(jpath)
+
+        rng = np.random.default_rng(seed)
+        start = time.monotonic()
+        deadline = start + duration_s
+        next_arrival = start
+        submitted = 0
+        while time.monotonic() < deadline or submitted == 0:
+            now = time.monotonic()
+            while next_arrival <= min(now, deadline) or submitted == 0:
+                prompt = rng.integers(
+                    0, config.vocab_size,
+                    size=int(rng.integers(3, 12))).tolist()
+                fleet.submit(prompt, max_new_tokens=int(rng.integers(4, 10)))
+                submitted += 1
+                next_arrival += float(rng.exponential(1.0 / rate_hz))
+            fleet.step()
+            fleet.check_invariants()
+        # A final burst right before the checkpoint: guaranteed in-flight
+        # work at the crash (an early Poisson lull could otherwise drain
+        # the fleet completely, leaving nothing to recover).
+        for _ in range(4):
+            prompt = rng.integers(0, config.vocab_size,
+                                  size=int(rng.integers(3, 12))).tolist()
+            fleet.submit(prompt, max_new_tokens=8)
+            submitted += 1
+        ck = os.path.join(workdir, "ckpt")
+        fleet.checkpoint(ck)
+        for _ in range(3):               # journal-suffix territory
+            fleet.step()
+        fleet.journal.crash()            # power cut mid-flight
+        donor = fleet.replicas[0].engine
+
+        t0 = time.monotonic()
+        restored = Fleet.restore(ck, engine, donor=donor, **kw)
+        recovery_s = time.monotonic() - t0
+        finished_at_restore = len(restored.finished)
+        restored.run(max_steps=100000)
+        restored.check_invariants()
+
+        completed = len(restored.finished)
+        failed = len(restored.failed)
+        lost = submitted - completed - failed
+        if lost or failed:
+            raise RuntimeError(
+                f"restore lost work: {submitted} submitted, {completed} "
+                f"ok, {failed} failed, {lost} vanished — the journal "
+                "contract is zero lost requests")
+        post_restore = completed - finished_at_restore
+        if post_restore < 1:
+            raise RuntimeError(
+                "no request finished after the restore — the recovered "
+                "fleet never actually served")
+        for rep in restored.replicas:
+            for kind, n in rep.engine.trace_counts.items():
+                if n > 1:
+                    raise RuntimeError(
+                        f"replica {rep.idx} {kind} step retraced {n} "
+                        "times during recovery")
+
+        m = {
+            "requests_submitted": submitted,
+            "requests_completed": completed,
+            "requests_failed": failed,
+            "requests_lost": lost,
+            "finished_after_restore": post_restore,
+            "restored_requests": int(restored.metrics.counters.get(
+                "restored_requests", 0.0)),
+            "recovery_s": round(recovery_s, 4),
+            "wall_s": round(time.monotonic() - start, 3),
+            "fleet_steps": restored.n_steps,
+        }
+        if perfdb_path:
+            from triton_distributed_tpu.obs.perfdb import PerfDB
+
+            sample = restored.perfdb_sample()
+            sample["requests_submitted"] = float(submitted)
+            sample["recovery_s"] = recovery_s
+            rec = PerfDB(perfdb_path).append(
+                suite="serve_smoke_restore", metrics=sample,
+                meta={"duration_s": duration_s, "rate_hz": rate_hz,
+                      "seed": seed, "n_replicas": n_replicas})
+            m["perfdb_run_id"] = rec.run_id
+        return m
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
 
 
 def main_adaptive(*, seed: int = 0, warmup: int = 24, burst: int = 48,
@@ -782,12 +909,26 @@ if __name__ == "__main__":
                          "through spec and plain engines; assert zero "
                          "output divergence, nonzero accepted drafts, "
                          "zero retraces")
+    ap.add_argument("--restore", action="store_true",
+                    help="run the crash-recovery arm: journaled Poisson "
+                         "load, checkpoint, simulated power cut, "
+                         "Fleet.restore; assert zero lost requests and "
+                         ">=1 finish after the restore")
     ap.add_argument("--stats-jsonl", default=None,
                     help="stream live stats_snapshot() JSON lines here "
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.incidents:
+        if args.restore:
+            if args.chaos or args.adaptive or args.spec or args.incidents:
+                raise SystemExit("--restore is its own arm; run it "
+                                 "without --chaos/--adaptive/--spec/"
+                                 "--incidents")
+            metrics = main_restore(
+                args.duration, rate_hz=args.rate, seed=args.seed,
+                n_replicas=max(2, args.replicas),
+                perfdb_path=args.perfdb)
+        elif args.incidents:
             if args.chaos or args.replicas > 1 or args.adaptive or args.spec:
                 raise SystemExit("--incidents is its own arm; run it "
                                  "without --chaos/--replicas/--adaptive/"

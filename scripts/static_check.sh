@@ -21,6 +21,12 @@
 #                                      neutral context / a boolean witness)
 #                                      so perf_gate.py never silently
 #                                      waves a regression through.
+#   5. tools/check_fault_sites.py   -> every fault-site literal passed to
+#                                      faults.fire()/FaultSpec(site=...)
+#                                      is declared in faults.KNOWN_SITES
+#                                      and documented in docs/resilience.md
+#                                      (typo'd sites silently rot chaos
+#                                      coverage otherwise).
 #
 # Usage: bash scripts/static_check.sh [--tier1]
 #   --tier1  additionally run the tier-1 pytest suite after the static
@@ -85,6 +91,10 @@ fi
 echo
 echo "== perfdb direction lint (tools/check_perfdb_directions.py) =="
 python tools/check_perfdb_directions.py || rc=1
+
+echo
+echo "== fault-site registry lint (tools/check_fault_sites.py) =="
+python tools/check_fault_sites.py || rc=1
 
 if [[ "${1:-}" == "--tier1" ]]; then
     echo

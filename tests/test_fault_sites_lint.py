@@ -1,0 +1,114 @@
+"""Tests for the fault-site registry lint (tools/check_fault_sites):
+the repo itself must be clean, a planted undeclared site must be caught
+(both as a ``fire(...)`` literal and a ``FaultSpec(site=...)``), f-string
+sites must be normalized with wildcards, and every registry entry must be
+documented in docs/resilience.md — so a typo'd fault site is a static
+failure, not silently-rotted chaos coverage.
+"""
+
+import importlib.util
+import io
+import pathlib
+
+import pytest
+
+from triton_distributed_tpu.resilience import faults
+
+_REPO = pathlib.Path(__file__).parent.parent
+_TOOL = _REPO / "tools" / "check_fault_sites.py"
+
+
+def _load():
+    spec = importlib.util.spec_from_file_location("check_fault_sites", _TOOL)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(scope="module")
+def mod():
+    return _load()
+
+
+def test_repo_is_clean(mod):
+    out = io.StringIO()
+    assert mod.run(str(_REPO), out=out) == 0, out.getvalue()
+    assert "OK" in out.getvalue()
+
+
+def test_repo_covers_known_fire_sites(mod):
+    sites = set()
+    for path in mod.lint_paths(str(_REPO)):
+        sites.update(s for s, _ in mod.scan_file(path))
+    # Spot-check the walk reaches all three literal classes: plain fire()
+    # constants, f-string sites (normalized to wildcards), and chaos-plan
+    # FaultSpec literals.
+    assert "sched.admit" in sites
+    assert "journal.append" in sites
+    assert "ckpt.save" in sites
+    assert any(s.startswith("replica.") and "*" in s for s in sites)
+    assert len(sites) >= 12
+
+
+def test_planted_undeclared_sites_caught(mod, tmp_path):
+    (tmp_path / "bench.py").write_text(
+        "from triton_distributed_tpu.resilience import faults\n"
+        "faults.fire('totally.bogus.site')\n"
+        "faults.FaultSpec(site='another.bogus', kind='error', p=1.0)\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "resilience.md").write_text(
+        " ".join(sorted(faults.KNOWN_SITES)) + "\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 1
+    text = out.getvalue()
+    assert "totally.bogus.site" in text
+    assert "another.bogus" in text
+
+
+def test_fstring_sites_normalized(mod, tmp_path):
+    # f"replica.{idx}.step" lints as replica.*.step — declared.
+    (tmp_path / "bench.py").write_text(
+        "from triton_distributed_tpu.resilience import faults\n"
+        "idx = 3\n"
+        "faults.fire(f'replica.{idx}.step')\n"
+        "faults.fire(f'comm.{name}')\n")
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "resilience.md").write_text(
+        " ".join(sorted(faults.KNOWN_SITES)) + "\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 0, out.getvalue()
+
+
+def test_undocumented_registry_entry_caught(mod, tmp_path):
+    (tmp_path / "bench.py").write_text("x = 1\n")
+    (tmp_path / "docs").mkdir()
+    # Document every site EXCEPT journal.append.
+    doc = " ".join(s for s in sorted(faults.KNOWN_SITES)
+                   if s != "journal.append")
+    (tmp_path / "docs" / "resilience.md").write_text(doc + "\n")
+    out = io.StringIO()
+    assert mod.run(str(tmp_path), out=out) == 1
+    assert "journal.append" in out.getvalue()
+
+
+def test_registry_semantics():
+    # Symmetric wildcard matching: a concrete site matches its declared
+    # pattern, a spec PREFIX pattern matches a declared concrete-ish
+    # entry, and unknown strings don't.
+    assert faults.site_known("replica.0.step")
+    assert faults.site_known("replica.*")          # spec prefix pattern
+    assert faults.site_known("comm.all_reduce")
+    assert faults.site_known("journal.append")
+    assert faults.site_known("ckpt.save")
+    assert faults.site_known("ckpt.restore")
+    assert not faults.site_known("journal.appendx")
+    assert not faults.site_known("totally.bogus")
+    # Every registry entry carries a docstring-style description.
+    for site, desc in faults.KNOWN_SITES.items():
+        assert isinstance(desc, str) and desc, site
+
+
+def test_cli_entrypoint(mod, capsys):
+    assert mod.main(["--root", str(_REPO)]) == 0
+    capsys.readouterr()
+    assert mod.main(["--root", str(_REPO / "no-such-dir")]) == 2

@@ -102,6 +102,26 @@ def test_serve_smoke_fleet_chaos(tmp_path):
     assert "fleet" in frame and "routable" in frame
 
 
+def test_serve_smoke_restore(tmp_path):
+    """The --restore contract (ISSUE 18): journaled Poisson load,
+    mid-flight checkpoint, simulated power cut, Fleet.restore onto fresh
+    replicas — zero requests lost, at least one finishes AFTER the
+    restore, and nothing retraces. main_restore raises on any violation
+    and records a perfdb sample when asked."""
+    db = tmp_path / "perf.jsonl"
+    m = _load().main_restore(1.5, rate_hz=8.0, seed=0,
+                             perfdb_path=str(db))
+    assert m["requests_submitted"] > 0
+    assert m["requests_lost"] == 0 and m["requests_failed"] == 0
+    assert m["requests_completed"] == m["requests_submitted"]
+    assert m["finished_after_restore"] >= 1
+    assert m["restored_requests"] >= 1
+    assert m["recovery_s"] >= 0.0
+    rec = json.loads(db.read_text().strip().splitlines()[-1])
+    assert rec["suite"] == "serve_smoke_restore"
+    assert rec["metrics"]["requests_submitted"] == m["requests_submitted"]
+
+
 def test_serve_smoke_adaptive(tmp_path):
     """The --adaptive contract (ISSUE 12): the overload burst drives the
     self-calibrated TTFT objective to WARN, the attached Controller

@@ -1,0 +1,179 @@
+#!/usr/bin/env python
+"""check_fault_sites: static lint — every fault-site string in the repo
+must be declared in ONE registry and documented.
+
+The fault plane (``resilience/faults.py``) matches sites by string, so a
+typo in a ``faults.fire("...")`` call or a chaos plan's
+``FaultSpec(site=...)`` silently creates a site no plan ever perturbs
+(or a spec no site ever matches) — the chaos coverage rots without a
+test failing. This lint walks the repo statically and fails when:
+
+  * a site literal passed to ``fire(...)`` / ``FaultSpec(site=...)``
+    does not match the ``faults.KNOWN_SITES`` registry. F-strings are
+    normalized with ``*`` in place of each formatted hole
+    (``f"replica.{idx}.step"`` lints as ``replica.*.step``), and
+    matching is symmetric-wildcard so a spec PREFIX pattern like
+    ``replica.*`` satisfies the declared ``replica.*.step``;
+  * a registry entry's site name does not appear in
+    ``docs/resilience.md`` — every declared site must be documented
+    where operators look for it.
+
+    python tools/check_fault_sites.py          # lint the repo
+    python tools/check_fault_sites.py -v       # list every site literal
+
+Exit 0 when every site is declared+documented, 1 on any violation,
+2 on usage errors. Wired into scripts/static_check.sh.
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.resilience.faults import (  # noqa: E402
+    KNOWN_SITES,
+    site_known,
+)
+
+_DOC_REL = os.path.join("docs", "resilience.md")
+
+
+def _site_pattern(node: ast.expr) -> str | None:
+    """The site string an AST argument denotes: a plain constant as-is,
+    an f-string with ``*`` standing in for each formatted hole, None for
+    anything non-literal (a variable site can't be linted statically)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("*")
+        return "".join(parts)
+    return None
+
+
+class _Collector(ast.NodeVisitor):
+    """Collects (site, lineno) literals from one module."""
+
+    def __init__(self):
+        self.sites: list[tuple[str, int]] = []
+
+    def visit_Call(self, node: ast.Call):
+        fn = node.func
+        name = None
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+        elif isinstance(fn, ast.Name):
+            name = fn.id
+        if name == "fire" and node.args:
+            site = _site_pattern(node.args[0])
+            if site is not None:
+                self.sites.append((site, node.lineno))
+        elif name == "FaultSpec":
+            arg = None
+            for kw in node.keywords:
+                if kw.arg == "site":
+                    arg = kw.value
+            if arg is None and node.args:
+                arg = node.args[0]
+            if arg is not None:
+                site = _site_pattern(arg)
+                if site is not None:
+                    self.sites.append((site, node.lineno))
+        self.generic_visit(node)
+
+
+def scan_file(path: str) -> list[tuple[str, int]]:
+    """All fault-site literals in ``path``: (site, lineno)."""
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    col = _Collector()
+    col.visit(ast.parse(src, filename=path))
+    return col.sites
+
+
+def lint_paths(root: str) -> list[str]:
+    """The files this lint covers, relative to ``root``."""
+    paths = [os.path.join(root, "bench.py")]
+    for sub in ("triton_distributed_tpu", "scripts"):
+        for dirpath, _dirs, files in sorted(os.walk(os.path.join(root, sub))):
+            paths.extend(os.path.join(dirpath, f)
+                         for f in sorted(files) if f.endswith(".py"))
+    return [p for p in paths if os.path.exists(p)]
+
+
+def undocumented_sites(root: str) -> list[str]:
+    """Registry entries whose site name is absent from docs/resilience.md
+    (``*`` holes compared literally — the doc table spells them
+    ``<idx>``/``<collective>``, so match on the stable prefix)."""
+    doc_path = os.path.join(root, _DOC_REL)
+    if not os.path.exists(doc_path):
+        return sorted(KNOWN_SITES)
+    with open(doc_path, encoding="utf-8") as f:
+        doc = f.read()
+    missing = []
+    for site in KNOWN_SITES:
+        # "replica.*.step" is documented as "replica.<idx>.step"; the
+        # stable literal prefix before the first wildcard is the anchor.
+        anchor = site.split("*")[0].rstrip(".") or site
+        if anchor not in doc:
+            missing.append(site)
+    return sorted(missing)
+
+
+def run(root: str, *, verbose: bool = False, out=sys.stdout) -> int:
+    n_sites = 0
+    violations: list[str] = []
+    for path in lint_paths(root):
+        rel = os.path.relpath(path, root)
+        for site, lineno in scan_file(path):
+            n_sites += 1
+            if site_known(site):
+                status = "declared"
+            else:
+                status = "UNDECLARED"
+                violations.append(
+                    f"{rel}:{lineno}: fault site {site!r} is not in "
+                    "resilience.faults.KNOWN_SITES")
+            if verbose:
+                out.write(f"{rel}:{lineno}: {site} -> {status}\n")
+    for site in undocumented_sites(root):
+        violations.append(f"{_DOC_REL}: declared site {site!r} is "
+                          "undocumented")
+    if violations:
+        out.write("\n".join(violations) + "\n")
+        out.write(f"check_fault_sites: {len(violations)} violation(s) "
+                  f"across {n_sites} site literals — declare the site in "
+                  "resilience/faults.py KNOWN_SITES and document it in "
+                  "docs/resilience.md\n")
+        return 1
+    out.write(f"check_fault_sites: OK ({n_sites} site literals, all "
+              f"declared; {len(KNOWN_SITES)} registry entries, all "
+              "documented)\n")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=_REPO_ROOT,
+                    help="repo root to lint (default: this checkout)")
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="print every discovered site literal")
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.root):
+        sys.stderr.write(f"check_fault_sites: no such root: {args.root}\n")
+        return 2
+    return run(args.root, verbose=args.verbose)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -336,6 +336,19 @@ class IncidentEngine:
         # incident interval.
         self._cursors = self._read_cursors()
         self._anom_cursors: dict | None = None
+        # Out-of-band operational annotations (crash/restore windows,
+        # spawn/retire events — resilience/checkpoint.py): bounded, ride
+        # stats()/dump() so a postmortem sees the recovery timeline next
+        # to the anomaly timeline.
+        self.annotations: deque[dict] = deque(maxlen=64)
+
+    def annotate(self, kind: str, **fields) -> dict:
+        """Record one operational annotation (e.g. ``restore`` with the
+        crash window, ``spawn``/``retire`` with the replica index) keyed
+        to the current observer step."""
+        ann = {"kind": kind, "step": self.n_steps, **fields}
+        self.annotations.append(ann)
+        return ann
 
     # -- cursoring ---------------------------------------------------------
 
@@ -597,6 +610,7 @@ class IncidentEngine:
             "severity_level": self.worst_severity_level(),
             "detect_latency_steps": self.max_detect_latency_steps(),
             "ring": [inc.as_dict() for inc in list(self.incidents)[-8:]],
+            "annotations": list(self.annotations)[-8:],
         }
 
     def dump(self) -> dict:
@@ -608,6 +622,7 @@ class IncidentEngine:
             "closed": self.n_closed,
             "evicted": self.n_evicted,
             "incidents": [inc.as_dict() for inc in self.incidents],
+            "annotations": list(self.annotations),
         }
 
     def perfdb_sample(self) -> dict:

@@ -18,6 +18,10 @@ timeline per request with a critical-path **latency attribution**:
   decode     admitted and emitting one token per step
   preempted  evicted-by-recompute gap (preempt -> re-admit, same replica)
   requeue    fleet-scope displacement (drain -> re-route, new replica)
+  restore    crash-recovery gap: a request replayed from the write-ahead
+             journal (resilience/checkpoint.py) re-begins its timeline in
+             this phase; the next route decision closes it, so the bucket
+             is the restore-to-placement wait
 
 Every instant between submit and finish is in exactly ONE phase, so the
 per-bucket fractions sum to the total latency (the ``explain_request``
@@ -56,7 +60,8 @@ import time
 from triton_distributed_tpu.obs.metrics import Metrics
 
 # The attribution buckets, in render order. See module docstring.
-BUCKETS = ("queue", "route", "prefill", "decode", "preempted", "requeue")
+BUCKETS = ("queue", "route", "prefill", "decode", "preempted", "requeue",
+           "restore")
 
 # Event kind -> phase entered. Kinds absent here ("prefill_chunk",
 # "first_token", annotations) leave the phase untouched.

@@ -261,7 +261,13 @@ _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            # clean trace). "detect_latency_steps" rides
                            # the "latency" hint already.
                            "oscillation", "bubble", "reversal",
-                           "incident")
+                           # "replay" (crash recovery: fleet steps the
+                           # restored run needed to finish the journaled
+                           # requests) — faster catch-up is strictly
+                           # better; "recovery_s" rides the "_s" latency
+                           # suffix. "lost_requests" (requests the
+                           # restore could not reconstruct) must be 0.
+                           "incident", "replay", "lost_requests")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
@@ -297,6 +303,11 @@ NEUTRAL_CONTEXT = frozenset({
     "pool_free_blocks", "pool_largest_free_run", "pool_cached_blocks",
     "pruned_configs", "controller_revives", "n_replicas",
     "requests_submitted", "warn_transitions",
+    # crash-recovery arm context (bench --serve --crash): configuration
+    # echoes and exercise witnesses — the smoke/bench asserts gate them
+    # directly (zero-lost, bit-identical), not the perfdb delta.
+    "crash_step", "crash_seed", "journal_records", "replica_spawns",
+    "replica_retirements", "restored_requests",
 })
 
 

@@ -20,6 +20,12 @@ default behind a single attribute check (the obs-layer pattern):
                        host-side) and ``RetryPolicy`` — bounded
                        exponential backoff for transient step failures,
                        with recovery-latency reporting.
+  resilience.checkpoint  crash-consistent recovery: the CRC-framed
+                       write-ahead ``RequestJournal`` plus fleet
+                       checkpoint save/load/verify — host-side truth
+                       only (the determinism contract recomputes device
+                       state), fingerprint-guarded against restoring
+                       into a different compiled world.
 
 ``install_hooks()`` wires faults + watchdog into ``obs.comm_ledger`` so
 every host-level collective wrapper in kernels/ becomes a fault site
@@ -27,10 +33,23 @@ every host-level collective wrapper in kernels/ becomes a fault site
 code changes. Design note: docs/resilience.md.
 """
 
+from triton_distributed_tpu.resilience import checkpoint  # noqa: F401
 from triton_distributed_tpu.resilience import faults  # noqa: F401
 from triton_distributed_tpu.resilience import guards  # noqa: F401
 from triton_distributed_tpu.resilience import watchdog  # noqa: F401
+from triton_distributed_tpu.resilience.checkpoint import (  # noqa: F401
+    CheckpointCorruption,
+    JournalCorruption,
+    RequestJournal,
+    load_checkpoint,
+    read_journal,
+    replay_requests,
+    save_checkpoint,
+    verify_checkpoint,
+    verify_journal,
+)
 from triton_distributed_tpu.resilience.faults import (  # noqa: F401
+    KNOWN_SITES,
     FaultEvent,
     FaultPlan,
     FaultSpec,
@@ -88,8 +107,11 @@ def uninstall_hooks(*, keep_plan: bool = False) -> None:
 
 
 __all__ = [
-    "FaultEvent", "FaultPlan", "FaultSpec", "Heartbeat", "QuarantineError",
-    "RetryPolicy", "TransientFault", "Watchdog", "WatchdogTimeout",
-    "bad_rows", "default_chaos_plan", "default_fleet_chaos_plan", "faults",
-    "guards", "install_hooks", "uninstall_hooks", "watchdog",
+    "CheckpointCorruption", "FaultEvent", "FaultPlan", "FaultSpec",
+    "Heartbeat", "JournalCorruption", "KNOWN_SITES", "QuarantineError",
+    "RequestJournal", "RetryPolicy", "TransientFault", "Watchdog",
+    "WatchdogTimeout", "bad_rows", "checkpoint", "default_chaos_plan",
+    "default_fleet_chaos_plan", "faults", "guards", "install_hooks",
+    "load_checkpoint", "read_journal", "replay_requests", "save_checkpoint",
+    "uninstall_hooks", "verify_checkpoint", "verify_journal", "watchdog",
 ]

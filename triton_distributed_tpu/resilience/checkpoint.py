@@ -1,0 +1,458 @@
+"""Crash-consistent recovery: write-ahead request journal + fleet
+checkpoints with bit-identical resume.
+
+The repo's determinism contract makes durable state CHEAP: drafters
+rebuild from the request's token history (``Drafter.adopt``), warm
+prefix-cache prefill is bit-identical to cold, and greedy decode is a
+pure function of prompt+output — so nothing on the device ever needs to
+be serialized. A checkpoint is host-side truth only (requests, token
+histories, reason chains, controller knobs, speculation windows), and a
+restored request simply re-enters the fleet queue and warm-starts via
+the existing prefill/prefix-cache recompute path. This is the AOT
+artifact story applied to serving state: persist what is unrecoverable,
+recompute the rest.
+
+Two durability layers compose:
+
+  ``RequestJournal``   a bounded write-ahead log, one CRC-framed JSON
+                       record per line (``crc32 <space> payload``).
+                       ``submit`` records are fsynced before the submit
+                       returns (a lost submit is a lost request);
+                       ``emit``/``finish``/``requeue`` batch-fsync every
+                       ``fsync_every`` appends — losing an unflushed
+                       emit tail is harmless because greedy decode
+                       regenerates the exact same tokens on replay.
+                       Torn tails (a crash mid-``write``) are detected
+                       by the per-frame CRC and truncated back to the
+                       last valid frame on the next open.
+  checkpoint           ``save_checkpoint``/``load_checkpoint``: a state
+                       JSON plus a ``manifest.json`` carrying the perfdb
+                       environment fingerprint (restore onto a different
+                       compiled world refuses with
+                       ``FingerprintMismatch``), the state CRC, and the
+                       journal sequence number at snapshot time — so
+                       ``Fleet.restore`` replays exactly the journal
+                       suffix written after the checkpoint.
+
+Chaos-exercised like every other resilience layer: ``journal.append``,
+``ckpt.save`` and ``ckpt.restore`` are fault sites, and the ``torn``
+fault kind makes ``append`` half-write a frame (then self-heal on the
+next append) so the CRC/torn-tail path is hit by the seeded plans, not
+just by real crashes. See docs/resilience.md ("Crash recovery & elastic
+fleet") and ``Fleet.checkpoint``/``restore``/``spawn``/``retire``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import zlib
+
+from triton_distributed_tpu.resilience import faults as _faults
+
+SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+STATE_NAME = "state.json"
+JOURNAL_NAME = "journal.jsonl"
+
+# Record kinds the journal accepts; replay understands all of them.
+RECORD_KINDS = ("submit", "admit", "emit", "finish", "fail", "requeue",
+                "ckpt", "restore")
+# Kinds that must be durable before the append returns: losing one loses
+# a request (submit) or a recovery line in the audit trail (markers).
+_DURABLE_KINDS = frozenset({"submit", "ckpt", "restore"})
+
+
+class JournalCorruption(ValueError):
+    """A journal frame failed its CRC (or was malformed) somewhere OTHER
+    than the torn tail — mid-file corruption is never auto-healed."""
+
+
+class CheckpointCorruption(ValueError):
+    """A checkpoint manifest/state pair failed integrity validation."""
+
+
+def _frame(payload: bytes) -> bytes:
+    return b"%08x %s\n" % (zlib.crc32(payload) & 0xFFFFFFFF, payload)
+
+
+def _parse_frame(line: bytes):
+    """Decode one journal line -> record dict, or raise ValueError."""
+    if len(line) < 10 or line[8:9] != b" ":
+        raise ValueError("short or unframed line")
+    crc = int(line[:8], 16)
+    payload = line[9:]
+    if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+        raise ValueError("CRC mismatch")
+    rec = json.loads(payload)
+    if not isinstance(rec, dict) or "kind" not in rec or "seq" not in rec:
+        raise ValueError("frame is not a journal record")
+    return rec
+
+
+class JournalRead:
+    """Result of ``read_journal``: the valid records plus what the torn-
+    tail scan found (``torn_bytes`` truncated-on-read; 0 = clean)."""
+
+    def __init__(self, records, torn_bytes: int, path: str):
+        self.records = records
+        self.torn_bytes = torn_bytes
+        self.path = path
+
+    @property
+    def last_seq(self) -> int:
+        return self.records[-1]["seq"] if self.records else -1
+
+
+def read_journal(path: str) -> JournalRead:
+    """Read every valid frame. A bad LAST line (no newline, short frame,
+    CRC mismatch) is a torn tail — dropped, counted in ``torn_bytes``.
+    A bad line with valid frames AFTER it is mid-file corruption and
+    raises ``JournalCorruption`` (a torn tail can only be at the end;
+    anything else means the file was tampered with or the disk lied)."""
+    with open(path, "rb") as f:
+        raw = f.read()
+    records = []
+    bad_at = None          # byte offset of the first undecodable line
+    offset = 0
+    for line in raw.splitlines(keepends=True):
+        clean = line.rstrip(b"\n")
+        try:
+            if not line.endswith(b"\n"):
+                raise ValueError("unterminated frame")
+            rec = _parse_frame(clean)
+        except (ValueError, json.JSONDecodeError):
+            bad_at = offset
+            offset += len(line)
+            continue
+        if bad_at is not None:
+            raise JournalCorruption(
+                f"{path}: invalid frame at byte {bad_at} followed by "
+                f"valid frames — mid-file corruption, not a torn tail")
+        records.append(rec)
+        offset += len(line)
+    torn = len(raw) - bad_at if bad_at is not None else 0
+    return JournalRead(records, torn, path)
+
+
+def verify_journal(path: str) -> list[str]:
+    """Integrity problems (empty list = healthy; a torn tail is reported
+    but is recoverable, so it is a warning-shaped entry prefixed
+    ``torn-tail``, while real corruption is fatal-shaped)."""
+    problems: list[str] = []
+    if not os.path.exists(path):
+        return [f"missing journal: {path}"]
+    try:
+        jr = read_journal(path)
+    except JournalCorruption as e:
+        return [f"corrupt journal: {e}"]
+    if jr.torn_bytes:
+        problems.append(f"torn-tail: {jr.torn_bytes} trailing bytes will "
+                        "be truncated on next open")
+    seq = -1
+    for rec in jr.records:
+        if rec["seq"] <= seq:
+            problems.append(f"corrupt journal: non-monotonic seq "
+                            f"{rec['seq']} after {seq}")
+            break
+        seq = rec["seq"]
+        if rec["kind"] not in RECORD_KINDS:
+            problems.append(f"corrupt journal: unknown record kind "
+                            f"{rec['kind']!r} at seq {seq}")
+            break
+    return problems
+
+
+class RequestJournal:
+    """Append-only write-ahead log of request lifecycle records.
+
+    Opening an existing journal first truncates any torn tail (a crash
+    mid-write leaves a partial frame; the CRC framing makes it
+    detectable) and resumes the sequence numbering after the last valid
+    record. Writes go through an os-level fd with explicit buffering so
+    a simulated crash (``crash()``) loses exactly the un-fsynced tail —
+    the same thing a real power cut loses."""
+
+    def __init__(self, path: str, *, fsync_every: int = 8):
+        self.path = path
+        self.fsync_every = max(1, int(fsync_every))
+        self.n_appends = 0
+        self.n_fsyncs = 0
+        self.n_torn_writes = 0
+        self.truncated_bytes = 0
+        existing = read_journal(path) if os.path.exists(path) else None
+        self._seq = existing.last_seq + 1 if existing is not None else 0
+        if existing is not None and existing.torn_bytes:
+            # Heal the torn tail before appending anything after it.
+            clean = os.path.getsize(path) - existing.torn_bytes
+            with open(path, "rb+") as f:
+                f.truncate(clean)
+            self.truncated_bytes = existing.torn_bytes
+        self._fd = os.open(path, os.O_WRONLY | os.O_CREAT | os.O_APPEND,
+                           0o644)
+        self._buf: list[bytes] = []
+        self._since_fsync = 0
+        # Byte offset of the last DURABLE frame boundary; a torn fault
+        # leaves garbage past it which the next append truncates (the
+        # same self-heal a crashed process gets at reopen).
+        self._dirty_tail = False
+        self._closed = False
+
+    # -- write path ---------------------------------------------------------
+
+    def append(self, kind: str, **fields) -> int:
+        """Append one record; returns its sequence number. Fires the
+        ``journal.append`` fault site: an ``error`` kind raises
+        ``TransientFault`` (nothing written), a ``torn`` kind writes half
+        the frame — the torn-tail path, chaos-exercised — then raises."""
+        if self._closed:
+            raise ValueError("journal is closed")
+        if kind not in RECORD_KINDS:
+            raise ValueError(f"unknown journal record kind {kind!r}")
+        torn = False
+        if _faults._PLAN is not None:
+            directive = _faults.fire("journal.append")
+            torn = directive is not None and directive[0] == "torn"
+        if self._dirty_tail:
+            self._heal_tail()
+        rec = {"seq": self._seq, "kind": kind, **fields}
+        payload = json.dumps(rec, separators=(",", ":"),
+                             sort_keys=True).encode()
+        frame = _frame(payload)
+        if torn:
+            # Simulate dying mid-write: half the frame reaches the disk.
+            self.flush(fsync=True)
+            os.write(self._fd, frame[:max(1, len(frame) // 2)])
+            os.fsync(self._fd)
+            self._dirty_tail = True
+            self.n_torn_writes += 1
+            raise _faults.TransientFault(
+                f"journal.append torn write (seq {self._seq})")
+        self._buf.append(frame)
+        self._seq += 1
+        self.n_appends += 1
+        self._since_fsync += 1
+        if kind in _DURABLE_KINDS:
+            self.flush(fsync=True)
+        elif self._since_fsync >= self.fsync_every:
+            self.flush(fsync=True)
+        return rec["seq"]
+
+    def _heal_tail(self) -> None:
+        """Truncate the partial frame a torn write left behind."""
+        jr = read_journal(self.path)
+        if jr.torn_bytes:
+            clean = os.path.getsize(self.path) - jr.torn_bytes
+            with open(self.path, "rb+") as f:
+                f.truncate(clean)
+            self.truncated_bytes += jr.torn_bytes
+        self._dirty_tail = False
+
+    def flush(self, *, fsync: bool = True) -> None:
+        if self._buf:
+            os.write(self._fd, b"".join(self._buf))
+            self._buf.clear()
+        if fsync:
+            os.fsync(self._fd)
+            self.n_fsyncs += 1
+            self._since_fsync = 0
+
+    def close(self) -> None:
+        if not self._closed:
+            self.flush(fsync=True)
+            os.close(self._fd)
+            self._closed = True
+
+    def crash(self) -> int:
+        """Test hook: die WITHOUT flushing — the buffered (un-fsynced)
+        records are lost exactly as a power cut would lose them. Returns
+        how many buffered records were dropped."""
+        lost = len(self._buf)
+        self._buf.clear()
+        os.close(self._fd)
+        self._closed = True
+        return lost
+
+    @property
+    def next_seq(self) -> int:
+        return self._seq
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def replay_requests(records, base: dict | None = None) -> dict:
+    """Fold journal records into per-request wire dicts: ``base`` (the
+    checkpoint's request table, wire-format) extended by the suffix.
+    Emit records append tokens, finish/fail records settle status, and
+    requeue records extend the displacement reason chain. Returns
+    ``{req_id: wire_dict}``; unknown-request emits are dropped (their
+    submit record was lost with an unflushed tail — greedy decode will
+    regenerate those tokens, so nothing is missing, but a request whose
+    SUBMIT was never durable cannot be conjured back)."""
+    reqs: dict = {} if base is None else {
+        rid: dict(w) for rid, w in base.items()}
+    for rec in records:
+        kind = rec["kind"]
+        rid = rec.get("req_id")
+        if kind == "submit":
+            reqs[rid] = {
+                "req_id": rid, "prompt": list(rec["prompt"]),
+                "max_new_tokens": rec["max_new_tokens"],
+                "priority": rec.get("priority", 0),
+                "arrival_seq": rec.get("arrival_seq"),
+                "tenant": rec.get("tenant"),
+                "output": [], "n_preemptions": 0,
+                "status": "pending", "error": None, "requeues": [],
+            }
+        elif rid not in reqs:
+            continue
+        elif kind == "emit":
+            reqs[rid]["output"].append(rec["tok"])
+        elif kind == "finish":
+            reqs[rid]["status"] = "ok"
+        elif kind == "fail":
+            reqs[rid]["status"] = "failed"
+            reqs[rid]["error"] = rec.get("error", "failed")
+        elif kind == "requeue":
+            reqs[rid].setdefault("requeues", []).append(
+                rec.get("reason", "requeue"))
+            reqs[rid]["n_preemptions"] = (
+                reqs[rid].get("n_preemptions", 0) + 1)
+        # "admit"/"ckpt"/"restore" are audit records; replay needs no
+        # action (re-admission recomputes placement from scratch).
+    return reqs
+
+
+# -- checkpoints ------------------------------------------------------------
+
+
+def save_checkpoint(ckpt_dir: str, state: dict, *,
+                    journal_seq: int = -1,
+                    journal_path: str | None = None,
+                    meta: dict | None = None) -> dict:
+    """Write ``state`` + a manifest to ``ckpt_dir`` (created). The state
+    file is written first and the manifest (with the state CRC and the
+    perfdb environment fingerprint) is atomically renamed into place
+    LAST, so a crash mid-save leaves no manifest — a directory without
+    one is simply not a checkpoint. Fires ``ckpt.save``. Returns the
+    manifest dict."""
+    from triton_distributed_tpu.obs import perfdb as _perfdb
+
+    if _faults._PLAN is not None:
+        _faults.fire("ckpt.save")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    payload = json.dumps(state, separators=(",", ":"),
+                         sort_keys=True).encode()
+    state_path = os.path.join(ckpt_dir, STATE_NAME)
+    tmp = state_path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(payload)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, state_path)
+    manifest = {
+        "schema": SCHEMA_VERSION,
+        "kind": "fleet",
+        "fingerprint": _perfdb.fingerprint(),
+        "state_crc32": zlib.crc32(payload) & 0xFFFFFFFF,
+        "state_bytes": len(payload),
+        "journal_seq": int(journal_seq),
+        "journal_path": journal_path,
+        **(meta or {}),
+    }
+    man_path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    tmp = man_path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, man_path)
+    return manifest
+
+
+def load_checkpoint(ckpt_dir: str, *, check_fingerprint: bool = True):
+    """Read and validate a checkpoint; returns ``(state, manifest)``.
+    Fires ``ckpt.restore``. Raises ``CheckpointCorruption`` on a missing
+    or CRC-failing state file, and ``perfdb.FingerprintMismatch`` when
+    the manifest's environment fingerprint is not comparable with the
+    current world — restoring host truth into a DIFFERENT compiled world
+    (other backend, world size, jax version) would silently break the
+    bit-identical-resume contract, so it is refused up front."""
+    from triton_distributed_tpu.obs import perfdb as _perfdb
+
+    if _faults._PLAN is not None:
+        _faults.fire("ckpt.restore")
+    man_path = os.path.join(ckpt_dir, MANIFEST_NAME)
+    state_path = os.path.join(ckpt_dir, STATE_NAME)
+    if not os.path.exists(man_path):
+        raise CheckpointCorruption(f"no manifest in {ckpt_dir} — not a "
+                                   "checkpoint (or a save died mid-way)")
+    try:
+        with open(man_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruption(f"unreadable manifest: {e}") from e
+    if manifest.get("schema") != SCHEMA_VERSION:
+        raise CheckpointCorruption(
+            f"checkpoint schema {manifest.get('schema')!r} != "
+            f"{SCHEMA_VERSION}")
+    try:
+        with open(state_path, "rb") as f:
+            payload = f.read()
+    except OSError as e:
+        raise CheckpointCorruption(f"unreadable state: {e}") from e
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    if crc != manifest.get("state_crc32"):
+        raise CheckpointCorruption(
+            f"state CRC mismatch: {crc:08x} != "
+            f"{manifest.get('state_crc32', 0):08x}")
+    if check_fingerprint:
+        here = _perfdb.fingerprint()
+        there = manifest.get("fingerprint", {})
+        if not _perfdb.comparable(here, there):
+            diffs = {k: (there.get(k), here.get(k))
+                     for k in _perfdb.COMPARABLE_KEYS
+                     if there.get(k) != here.get(k)}
+            raise _perfdb.FingerprintMismatch(
+                f"checkpoint was taken in a different compiled world: "
+                f"{diffs} — refusing to resume (outputs would not be "
+                "bit-identical)")
+    return json.loads(payload), manifest
+
+
+def verify_checkpoint(ckpt_dir: str, *, journal_path: str | None = None,
+                      check_fingerprint: bool = False) -> list[str]:
+    """Bounded integrity probe for ``pod_check --restore``: manifest +
+    state CRC + (when present or given) journal frame validation.
+    Returns the problem list (empty = restorable). Never raises."""
+    problems: list[str] = []
+    try:
+        state, manifest = load_checkpoint(
+            ckpt_dir, check_fingerprint=check_fingerprint)
+    except Exception as e:  # noqa: BLE001 — probe reports, never crashes
+        return [f"{type(e).__name__}: {e}"]
+    n_reqs = len(state.get("requests", ()))
+    if journal_path is None:
+        journal_path = manifest.get("journal_path")
+        if journal_path and not os.path.isabs(journal_path):
+            journal_path = os.path.join(ckpt_dir, journal_path)
+    if journal_path:
+        jp = verify_journal(journal_path)
+        # a torn tail heals on open; everything else is a real problem
+        problems.extend(p for p in jp if not p.startswith("torn-tail"))
+        if not problems and os.path.exists(journal_path):
+            jr = read_journal(journal_path)
+            if manifest.get("journal_seq", -1) > jr.last_seq:
+                problems.append(
+                    f"journal ends at seq {jr.last_seq} but the manifest "
+                    f"claims {manifest['journal_seq']} — the journal was "
+                    "truncated past the checkpoint barrier")
+    elif not n_reqs:
+        problems.append("checkpoint holds zero requests and names no "
+                        "journal — nothing restorable")
+    return problems

@@ -1,7 +1,7 @@
 """Deterministic fault-injection plane (chaos testing for the serving path).
 
 A ``FaultPlan`` perturbs NAMED SITES in the host-side control flow with
-three fault kinds:
+four fault kinds:
 
   error   raise ``TransientFault`` (a retryable failure — the injected
           analog of a flaky DMA submit or an allocator hiccup)
@@ -10,8 +10,14 @@ three fault kinds:
           its DEVICE data (the batch engine adds NaN into one slot's
           logits row through an always-present zero operand, so injection
           never changes a compiled shape)
+  torn    return a torn-write directive only ``journal.append`` honors:
+          the journal writes HALF of the CRC frame, fsyncs, and raises —
+          the on-disk state a process dying mid-``write`` leaves, so the
+          torn-tail truncation path is chaos-exercised
 
-Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
+Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``; the
+machine-readable registry is ``KNOWN_SITES`` below, linted by
+``tools/check_fault_sites.py``):
 
   sched.admit          Scheduler admission (serving/batch_engine._admit)
   pool.ensure          KV-pool block allocation (serving/kv_pool.ensure)
@@ -35,6 +41,15 @@ Sites currently wired (grep ``faults.fire`` / ``_FAULT_HOOK``):
                        the plant keeps its previous knob values
   comm.<collective>    every host-level collective wrapper in kernels/
                        (via the ``obs.comm_ledger.timed`` hook)
+  journal.append       one write-ahead journal record append
+                       (resilience/checkpoint.py) — ``error`` fires
+                       BEFORE anything is written; ``torn`` half-writes
+                       the frame (see kinds above)
+  ckpt.save            checkpoint save (resilience/checkpoint.py) —
+                       fires before the state file is written, so a
+                       faulted save leaves the previous checkpoint intact
+  ckpt.restore         checkpoint load — fires before the manifest is
+                       read, so a faulted restore leaves the fleet unbuilt
 
 Determinism is the whole point: every decision comes from a per-(spec,
 site) ``random.Random`` stream seeded by ``(plan.seed, spec index, site)``
@@ -60,13 +75,51 @@ class TransientFault(RuntimeError):
     the bounded-backoff retry path in ``resilience.guards``)."""
 
 
+# The single source of truth for fault-site names: every string literal
+# passed to ``fire(...)`` / ``FaultSpec(site=...)`` anywhere in the repo
+# must match a pattern here (``*`` wildcards allowed on either side), and
+# every name here must be documented in docs/resilience.md —
+# ``tools/check_fault_sites.py`` enforces both, wired into
+# scripts/static_check.sh and tier 1.
+KNOWN_SITES = {
+    "sched.admit": "scheduler admission (serving/batch_engine._admit)",
+    "pool.ensure": "KV-pool block allocation (serving/kv_pool.ensure)",
+    "cache.lookup": "prefix-cache match probes (serving/prefix_cache)",
+    "engine.decode": "the batched decode step (serving/batch_engine)",
+    "engine.prefill": "the batched mixed/prefill step",
+    "replica.*.step": "one fleet replica's whole engine step "
+                      "(serving/fleet.py)",
+    "router.route": "fleet request placement (serving/router.py)",
+    "controller.act": "adaptive control-plane actuation "
+                      "(serving/controller.py)",
+    "comm.*": "host-level collective wrappers (obs/comm_ledger hook)",
+    "journal.append": "write-ahead journal record append "
+                      "(resilience/checkpoint.py)",
+    "ckpt.save": "checkpoint save (resilience/checkpoint.py)",
+    "ckpt.restore": "checkpoint load (resilience/checkpoint.py)",
+}
+
+
+def site_known(site: str) -> bool:
+    """True if ``site`` (a literal or a spec pattern, ``*`` allowed)
+    matches the ``KNOWN_SITES`` registry — the check the static lint and
+    ``FaultSpec`` share. Matching is symmetric fnmatch so a spec PREFIX
+    pattern like ``replica.*`` matches the declared ``replica.*.step``."""
+    import fnmatch
+
+    return any(site == known
+               or fnmatch.fnmatch(site, known)
+               or fnmatch.fnmatch(known, site)
+               for known in KNOWN_SITES)
+
+
 @dataclasses.dataclass(frozen=True)
 class FaultSpec:
     """One perturbation rule. ``site`` matches exactly, or as a prefix when
     it ends with ``*`` (``comm.*`` hits every collective)."""
 
     site: str
-    kind: str                   # "error" | "delay" | "nan"
+    kind: str                   # "error" | "delay" | "nan" | "torn"
     p: float = 1.0              # per-call fire probability
     delay_s: float = 0.0        # sleep length for kind="delay"
     row: int | None = None      # target slot row for kind="nan" (None = 0)
@@ -74,7 +127,7 @@ class FaultSpec:
     max_fires: int | None = None  # stop firing after N fires (None = inf)
 
     def __post_init__(self):
-        if self.kind not in ("error", "delay", "nan"):
+        if self.kind not in ("error", "delay", "nan", "torn"):
             raise ValueError(f"unknown fault kind {self.kind!r}")
         if not 0.0 <= self.p <= 1.0:
             raise ValueError(f"fault probability {self.p} not in [0, 1]")
@@ -130,9 +183,10 @@ class FaultPlan:
         return len(self.log)
 
     def fire(self, site: str):
-        """Evaluate ``site``'s call against the plan. Returns ``None`` or a
-        ``("nan", row)`` payload-corruption directive; raises
-        ``TransientFault`` for a matched error spec; sleeps for delays."""
+        """Evaluate ``site``'s call against the plan. Returns ``None``, a
+        ``("nan", row)`` payload-corruption directive, or a
+        ``("torn", None)`` torn-write directive; raises ``TransientFault``
+        for a matched error spec; sleeps for delays."""
         idx = self._calls.get(site, 0)
         self._calls[site] = idx + 1
         directive = None
@@ -155,6 +209,8 @@ class FaultPlan:
                 time.sleep(spec.delay_s)
             elif spec.kind == "nan" and directive is None:
                 directive = ("nan", spec.row if spec.row is not None else 0)
+            elif spec.kind == "torn" and directive is None:
+                directive = ("torn", None)
             elif spec.kind == "error" and error is None:
                 error = ev
         if error is not None:

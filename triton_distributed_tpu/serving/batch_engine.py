@@ -312,6 +312,10 @@ class BatchEngine:
         # Per-step draft proposals, slot index -> token list; rebuilt by
         # ``step()`` every iteration (never carried across steps).
         self._proposals: dict[int, list[int]] = {}
+        # Write-ahead journal (resilience/checkpoint.py), attached by
+        # ``Fleet.attach_journal``: emit/finish/fail records flow through
+        # ``_journal`` below. None = journaling off (zero overhead).
+        self.journal = None
         if self.incidents is not None:
             self._wire_incident_sources(self.incidents)
         self._build_steps()
@@ -381,6 +385,34 @@ class BatchEngine:
 
         self._decode_step = decode_step
         self._mixed_step = mixed_step
+
+    def share_steps_from(self, other: "BatchEngine") -> None:
+        """Adopt ``other``'s compiled step callables (elastic spawn,
+        ``Fleet.spawn``): both engines wrap the SAME model ``Engine``, so
+        the jitted closures — keyed on operand shapes, which identical
+        construction parameters make identical — are reusable as-is, and
+        a spawned replica serves its first token with zero retraces.
+
+        ``trace_counts`` is shared as the SAME dict object: the closures
+        captured it at trace time, so per-replica counts read {1,1} on
+        every sharer and the per-replica retrace formula
+        (decode+prefill-2) sums to zero fleet-wide. Our own never-called
+        closures from ``_build_steps`` are dropped untraced (jax.jit is
+        lazy — no compilation happened for them)."""
+        if other.engine is not self.engine:
+            raise ValueError("share_steps_from requires the same model "
+                             "Engine (one-model fleet design)")
+        same = (self.n_slots == other.n_slots
+                and self.prefill_chunk == other.prefill_chunk
+                and self.paged_attn == other.paged_attn
+                and (self.spec is None) == (other.spec is None))
+        if not same:
+            raise ValueError("share_steps_from requires identical step "
+                             "geometry (n_slots/prefill_chunk/paged_attn/"
+                             "speculation)")
+        self._decode_step = other._decode_step
+        self._mixed_step = other._mixed_step
+        self.trace_counts = other.trace_counts
 
     def _next_key(self):
         if self.engine.temperature == 0.0:
@@ -1119,6 +1151,7 @@ class BatchEngine:
                 self.journey.event(req.req_id, "admit", ctx_len=len(ctx),
                                    cached=matched,
                                    readmit=req.n_preemptions > 0)
+            self._journal("admit", req_id=req.req_id, ctx_len=len(ctx))
 
     def _preempt(self, idx: int):
         s = self._slots[idx]
@@ -1175,6 +1208,20 @@ class BatchEngine:
             if victim == idx:
                 return False
 
+    def _journal(self, kind: str, **fields) -> None:
+        """Best-effort journal append: emit/finish/fail/admit records are
+        RECOVERABLE by determinism (a lost emit re-decodes to the same
+        token on replay; a lost finish re-finishes), so a journal fault
+        here degrades to a metric instead of failing the step. Only
+        ``submit`` records demand durability — the fleet writes those
+        itself, before registering the request."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(kind, **fields)
+        except _faults.TransientFault:
+            self.metrics.inc("journal_faults")
+
     def _finish(self, idx: int):
         s = self._slots[idx]
         s.req.finish_t = time.monotonic()
@@ -1212,6 +1259,8 @@ class BatchEngine:
             # The TailSampler verdict decides full-detail retention; the
             # recorder force-keeps failed/displaced journeys on its own.
             self.journey.finish(s.req.req_id, status="ok", keep=kept)
+        self._journal("finish", req_id=s.req.req_id,
+                      n_tokens=len(s.req.output))
 
     def _quarantine(self, idx: int, reason: str):
         """Fail ONE request without failing the batch: release its blocks,
@@ -1248,8 +1297,10 @@ class BatchEngine:
         if self.journey is not None:
             self.journey.finish(req.req_id, status="failed", error=reason,
                                 keep=True)
+        self._journal("fail", req_id=req.req_id, error=reason)
 
     def _record_token(self, s: _Slot, tok: int):
+        self._journal("emit", req_id=s.req.req_id, tok=int(tok))
         s.req.output.append(tok)
         s.last_tok = tok
         if self.spec is not None:

@@ -546,6 +546,51 @@ class Controller:
     def knob_values(self) -> dict:
         return {name: k.value for name, k in self.knobs.items()}
 
+    # -- checkpoint wire format (resilience/checkpoint.py) ------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe control state for ``Fleet.checkpoint``: knob values
+        with their hysteresis bookkeeping, plus the tick/streak counters —
+        enough that a restored controller resumes the SAME decision
+        sequence (cooldowns and relax gates depend on tick deltas, which
+        ``last_move_tick`` preserves relative to ``n_ticks``)."""
+        return {
+            "knobs": {name: {"value": k.value,
+                             "last_move_tick": k.last_move_tick,
+                             "last_dir": k.last_dir,
+                             "reversals": k.reversals}
+                      for name, k in self.knobs.items()},
+            "n_ticks": self.n_ticks,
+            "n_actions": self.n_actions,
+            "n_act_faults": self.n_act_faults,
+            "n_evictions": self.n_evictions,
+            "n_revives": self.n_revives,
+            "ok_streak": self._ok_streak,
+            "steps_seen": self._steps_seen,
+        }
+
+    def restore(self, snap: dict) -> None:
+        """Adopt a ``snapshot()`` and RE-ACTUATE every knob value onto the
+        bound plant (the plant was rebuilt from scratch; its knobs sit at
+        construction defaults until pushed)."""
+        for name, ks in snap.get("knobs", {}).items():
+            knob = self.knobs.get(name)
+            if knob is None:
+                continue
+            knob.value = knob.clamp(ks["value"])
+            knob.last_move_tick = int(ks.get("last_move_tick",
+                                             knob.last_move_tick))
+            knob.last_dir = int(ks.get("last_dir", 0))
+            knob.reversals = int(ks.get("reversals", 0))
+            self._set_knob(name, knob.value)
+        self.n_ticks = int(snap.get("n_ticks", 0))
+        self.n_actions = int(snap.get("n_actions", 0))
+        self.n_act_faults = int(snap.get("n_act_faults", 0))
+        self.n_evictions = int(snap.get("n_evictions", 0))
+        self.n_revives = int(snap.get("n_revives", 0))
+        self._ok_streak = int(snap.get("ok_streak", 0))
+        self._steps_seen = int(snap.get("steps_seen", 0))
+
     def stats(self) -> dict:
         """The serve_top controller pane: knob values, last action +
         reason, actions/min (wall-clock display only), flap counters."""

@@ -62,6 +62,7 @@ import numpy as np
 from triton_distributed_tpu.obs import trace as _trace
 from triton_distributed_tpu.obs.journey import JourneyRecorder
 from triton_distributed_tpu.obs.slo import STATE_LEVEL
+from triton_distributed_tpu.resilience import checkpoint as _ckpt
 from triton_distributed_tpu.resilience import faults as _faults
 from triton_distributed_tpu.resilience import guards as _guards
 from triton_distributed_tpu.serving.batch_engine import BatchEngine
@@ -188,6 +189,16 @@ class Fleet:
         # land in one survivor's queue.
         self._arrival = itertools.count()
         self.state_log: list[dict] = []
+        # Crash-consistent recovery (resilience/checkpoint.py): the
+        # write-ahead journal (attach_journal), requests reconstructed
+        # already-finished by ``restore`` (merged into ``finished`` — the
+        # engines never saw them finish), and the construction spec
+        # ``build``/``restore`` record so ``spawn()`` can mint an
+        # identically-configured replica.
+        self.journal = None
+        self._restored_finished: dict[object, Request] = {}
+        self._build_spec = None
+        self._controller_snapshot = None
         # ONE journey recorder shared across every replica (replacing the
         # per-engine ones), so a request that drains off replica A and
         # finishes on replica B is a single stitched timeline. Disabled
@@ -237,12 +248,15 @@ class Fleet:
             raise ValueError("n_replicas must be >= 1")
         engines = [BatchEngine(engine, **batch_engine_kwargs)
                    for _ in range(n_replicas)]
-        return cls(engines, router=router, requeue=requeue,
-                   fail_threshold=fail_threshold,
-                   breach_quarantine_evals=breach_quarantine_evals,
-                   recovery_steps=recovery_steps,
-                   admission_pressure=admission_pressure,
-                   revive_cooldown_steps=revive_cooldown_steps)
+        fleet = cls(engines, router=router, requeue=requeue,
+                    fail_threshold=fail_threshold,
+                    breach_quarantine_evals=breach_quarantine_evals,
+                    recovery_steps=recovery_steps,
+                    admission_pressure=admission_pressure,
+                    revive_cooldown_steps=revive_cooldown_steps)
+        # Recorded so ``spawn()`` can build an identical replica later.
+        fleet._build_spec = (engine, dict(batch_engine_kwargs))
+        return fleet
 
     # -- request intake -----------------------------------------------------
 
@@ -278,6 +292,17 @@ class Fleet:
                       max_new_tokens=max_new_tokens, priority=priority,
                       arrival_seq=next(self._arrival),
                       submit_t=time.monotonic(), tenant=tenant)
+        if self.journal is not None:
+            # The WAL contract: a request exists once its submit record is
+            # DURABLE (RequestJournal fsyncs submit frames immediately).
+            # Journal BEFORE registering, and let a journal fault
+            # propagate to the caller — an unjournaled accepted request
+            # would be silently lost by a crash, which is the one thing
+            # this subsystem exists to prevent.
+            self.journal.append("submit", req_id=req_id, prompt=prompt,
+                                max_new_tokens=int(max_new_tokens),
+                                priority=int(priority),
+                                arrival_seq=req.arrival_seq, tenant=tenant)
         self._submitted[req_id] = req
         self._pending.append(req)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
@@ -443,6 +468,258 @@ class Fleet:
                          f"(revive #{rep.revives})")
         return True
 
+    # -- crash-consistent recovery (resilience/checkpoint.py) ---------------
+
+    def _journal_safe(self, kind: str, **fields) -> None:
+        """Best-effort journal append for records determinism can heal
+        (requeue/fail chains replay from the suffix; a lost one only
+        loses audit detail, never a request) — a journal fault degrades
+        to a metric. Submit records do NOT come through here."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(kind, **fields)
+        except _faults.TransientFault:
+            self.metrics.inc("journal_faults")
+
+    def attach_journal(self, path: str, *, fsync_every: int = 8):
+        """Open (or resume — torn tails heal) the write-ahead journal at
+        ``path`` and propagate it to every replica engine: from here on,
+        submits are durable before they are accepted and every
+        emit/finish/fail/requeue is framed into the log. Returns the
+        ``RequestJournal``."""
+        self.journal = _ckpt.RequestJournal(path, fsync_every=fsync_every)
+        for rep in self.replicas:
+            rep.engine.journal = self.journal
+        return self.journal
+
+    def _snapshot_state(self) -> dict:
+        # Peek the arrival counter without perturbing it (itertools.count
+        # has no peek: read one, rebuild from the same value).
+        nxt = next(self._arrival)
+        self._arrival = itertools.count(nxt)
+        eng0 = self.replicas[0].engine
+        return {
+            "n_steps": self.n_steps,
+            "req_counter": self._req_counter,
+            "next_arrival": nxt,
+            "requests": {str(rid): req.to_wire()
+                         for rid, req in self._submitted.items()},
+            "requeues": {str(rid): list(chain)
+                         for rid, chain in self._requeues.items()},
+            "pool_geometry": eng0.pool.geometry(),
+            "n_slots": eng0.n_slots,
+            "spec": [rep.engine.spec.controller.snapshot()
+                     if rep.engine.spec is not None else None
+                     for rep in self.replicas],
+            "controller": (self._controller.snapshot()
+                           if self._controller is not None else None),
+        }
+
+    def checkpoint(self, ckpt_dir: str) -> dict:
+        """Snapshot the fleet's HOST-SIDE truth to ``ckpt_dir``: request
+        table with token histories, displacement chains, arrival/req
+        counters, pool geometry (metadata only — KV bytes recompute via
+        prefill on re-admission), per-replica SpecController windows, and
+        the controller knob state. The manifest pins the journal sequence
+        number at the snapshot barrier, so ``restore`` replays exactly
+        the suffix written afterwards. Returns the manifest."""
+        journal_seq, journal_path = -1, None
+        if self.journal is not None:
+            self.journal.flush(fsync=True)
+            journal_seq = self.journal.next_seq - 1
+            journal_path = self.journal.path
+        manifest = _ckpt.save_checkpoint(
+            ckpt_dir, self._snapshot_state(),
+            journal_seq=journal_seq, journal_path=journal_path,
+            meta={"n_replicas": len(self.replicas)})
+        self._journal_safe("ckpt", journal_seq=journal_seq)
+        self.metrics.inc("checkpoints")
+        return manifest
+
+    @classmethod
+    def restore(cls, ckpt_dir: str, engine, *, journal_path=None,
+                n_replicas: int | None = None, router=None, requeue=None,
+                fail_threshold: int = 3, breach_quarantine_evals: int = 3,
+                recovery_steps: int = 8, admission_pressure: float = 0.0,
+                revive_cooldown_steps: int = 8, donor=None,
+                **batch_engine_kwargs) -> "Fleet":
+        """Build a fresh fleet and adopt a checkpoint + journal suffix.
+
+        The determinism contract does the heavy lifting: an unfinished
+        request re-enters the fleet queue as a plain pending request whose
+        context is prompt + everything journaled so far — the router
+        re-places it anywhere, ``adopt`` re-prefills (prefix-cache
+        warm-start when possible), and greedy decode continues the
+        bit-identical token stream. No device state is read back;
+        restore IS requeue-by-recompute at fleet scope.
+
+        ``n_replicas`` defaults to the checkpointed count (pass another
+        value for elastic restore). ``donor`` (a ``BatchEngine`` with
+        already-traced steps and identical geometry) lets every new
+        replica share compiled steps instead of retracing — the
+        kill-sweep tests restore dozens of fleets against one compile.
+        Refuses a checkpoint from a different compiled world
+        (``FingerprintMismatch``) or mismatched pool geometry."""
+        state, manifest = _ckpt.load_checkpoint(ckpt_dir)
+        if n_replicas is None:
+            n_replicas = int(manifest.get("n_replicas", 1))
+        fleet = cls.build(
+            engine, n_replicas=n_replicas, router=router, requeue=requeue,
+            fail_threshold=fail_threshold,
+            breach_quarantine_evals=breach_quarantine_evals,
+            recovery_steps=recovery_steps,
+            admission_pressure=admission_pressure,
+            revive_cooldown_steps=revive_cooldown_steps,
+            **batch_engine_kwargs)
+        if donor is not None:
+            for rep in fleet.replicas:
+                rep.engine.share_steps_from(donor)
+        geo = state.get("pool_geometry", {})
+        for rep in fleet.replicas:
+            here = rep.engine.pool.geometry()
+            if geo and here != geo:
+                raise ValueError(
+                    f"replica {rep.idx} pool geometry {here} != "
+                    f"checkpointed {geo} — admission/preemption decisions "
+                    "would diverge, breaking bit-identical resume")
+        if journal_path is None:
+            journal_path = manifest.get("journal_path")
+        fleet._adopt_checkpoint(state, manifest, journal_path)
+        return fleet
+
+    def _adopt_checkpoint(self, state: dict, manifest: dict,
+                          journal_path) -> None:
+        import os
+
+        suffix = []
+        if journal_path and os.path.exists(journal_path):
+            jr = _ckpt.read_journal(journal_path)
+            barrier = int(manifest.get("journal_seq", -1))
+            suffix = [r for r in jr.records if r["seq"] > barrier]
+        reqs = _ckpt.replay_requests(suffix, base=state.get("requests", {}))
+        self.n_steps = int(state.get("n_steps", 0))
+        self._req_counter = int(state.get("req_counter", 0))
+        self._arrival = itertools.count(int(state.get("next_arrival", 0)))
+        chains = {rid: list(c)
+                  for rid, c in state.get("requeues", {}).items()}
+        n_pending = 0
+        for wire in sorted(reqs.values(),
+                           key=lambda w: (w.get("arrival_seq") is None,
+                                          w.get("arrival_seq") or 0)):
+            req = Request.from_wire(wire)
+            rid = req.req_id
+            chain = chains.get(rid, []) + wire.get("requeues", [])[
+                len(chains.get(rid, [])):]
+            if chain:
+                self._requeues[rid] = chain
+            self._submitted[rid] = req
+            if (req.status == "pending"
+                    and len(req.output) >= req.max_new_tokens):
+                # Crashed between the last journaled emit and the finish
+                # record: the output is already complete (and finish adds
+                # no tokens), so the request finished — just unwitnessed.
+                req.status = "ok"
+            if req.status == "ok":
+                self._restored_finished[rid] = req
+            elif req.status == "failed":
+                self._failed[rid] = req
+            else:
+                req.status = "pending"
+                req.submit_t = time.monotonic()
+                if self.journey is not None:
+                    req.journey = self.journey.begin(
+                        rid, phase="restore", restored=True,
+                        prompt_len=len(req.prompt),
+                        replayed_tokens=len(req.output))
+                self._pending.append(req)
+                n_pending += 1
+        if reqs:
+            self.metrics.inc("restored_requests", float(len(reqs)))
+        if self.incidents is not None:
+            self.incidents.annotate(
+                "restore", checkpoint_step=int(state.get("n_steps", 0)),
+                requests=len(reqs), replayed_records=len(suffix),
+                pending=n_pending)
+        # The controller snapshot applies when a controller attaches
+        # (attach_controller below) — knob values re-actuate then.
+        self._controller_snapshot = state.get("controller")
+        for rep, snap in zip(self.replicas, state.get("spec") or ()):
+            if snap and rep.engine.spec is not None:
+                rep.engine.spec.controller.restore(snap)
+        if journal_path:
+            # Reopen for continued writes (heals any torn tail, resumes
+            # the sequence) and mark the recovery in the log itself.
+            self.attach_journal(journal_path)
+            self._journal_safe("restore", requests=len(reqs),
+                               pending=n_pending)
+
+    # -- elastic scale ------------------------------------------------------
+
+    def spawn(self) -> int:
+        """Add one identically-configured replica, serving WITHOUT a
+        retrace: the new engine adopts a live replica's compiled steps
+        (``share_steps_from`` — same model Engine, same geometry, so the
+        jitted closures are reusable as-is and ``trace_counts`` stays
+        {1,1} on every sharer). Returns the new replica's index."""
+        if self._build_spec is None:
+            raise ValueError("spawn() needs the construction spec — build "
+                             "the fleet via Fleet.build()/restore()")
+        engine, kwargs = self._build_spec
+        eng = BatchEngine(engine, **kwargs)
+        donor = next((rep.engine for rep in self.replicas
+                      if rep.state != DEAD), self.replicas[0].engine)
+        eng.share_steps_from(donor)
+        idx = len(self.replicas)
+        rep = Replica(idx=idx, engine=eng)
+        self.replicas.append(rep)
+        if self.journey is not None:
+            eng.journey = self.journey
+        if eng.incidents is not None:
+            eng.incidents.replica = idx
+        eng.journal = self.journal
+        if self._controller is not None:
+            # A fleet controller actuates knobs on EVERY replica; push the
+            # current values so the newcomer doesn't sit at construction
+            # defaults until the next move.
+            for name, value in self._controller.knob_values().items():
+                self._controller._set_knob(name, value)
+        self.metrics.inc("replica_spawns")
+        self._transition(rep, HEALTHY, f"spawned as replica {idx}")
+        if self.incidents is not None:
+            self.incidents.annotate("spawn", replica=idx)
+        return idx
+
+    def retire(self, idx: int) -> int:
+        """Administratively remove a replica from service: drain its
+        requests back to the fleet queue (full displacement reason
+        chains; the requeue budget applies) and mark it DEAD — the same
+        teardown a quarantine gets, minus the health verdict. Returns
+        the number of requests drained to survivors."""
+        rep = self.replicas[idx]
+        if rep.state == DEAD:
+            raise ValueError(f"replica {idx} is already DEAD")
+        if sum(r.state in ROUTABLE for r in self.replicas
+               if r.idx != idx) < 1:
+            raise ValueError("refusing to retire the last routable "
+                             "replica — the fleet could serve nothing")
+        reason = f"replica {idx} retired"
+        reqs = rep.engine.drain(reason=reason)
+        hb = rep.engine.heartbeat
+        if hb is not None:
+            hb.stop_monitor()
+        rep.requeued += len(reqs)
+        for req in reqs:
+            self._requeue(req, reason)
+        rep.died_at_step = self.n_steps
+        self.metrics.inc("replica_retirements")
+        self._transition(rep, DEAD,
+                         f"retired ({len(reqs)} request(s) drained)")
+        if self.incidents is not None:
+            self.incidents.annotate("retire", replica=idx,
+                                    drained=len(reqs))
+        return len(reqs)
+
     # -- control plane ------------------------------------------------------
 
     def attach_controller(self, controller=None, **kwargs):
@@ -457,6 +734,11 @@ class Fleet:
         if controller is None:
             controller = Controller(fleet=self, **kwargs)
         self._controller = controller
+        if self._controller_snapshot is not None:
+            # Restored fleet: re-adopt the checkpointed knob state (and
+            # re-actuate the values onto the rebuilt replicas).
+            controller.restore(self._controller_snapshot)
+            self._controller_snapshot = None
         return controller
 
     @property
@@ -471,6 +753,7 @@ class Fleet:
         req.error = " -> ".join([*chain, reason]) if chain else reason
         req.finish_t = time.monotonic()
         self._failed[req.req_id] = req
+        self._journal_safe("fail", req_id=req.req_id, error=req.error)
         self.metrics.inc("requests_failed")
         _trace.async_end("request", req.req_id, failed=True,
                          error=req.error)
@@ -490,6 +773,7 @@ class Fleet:
                             f"({self.requeue.retries} allowed)")
             return
         self._pending.append(req)
+        self._journal_safe("requeue", req_id=req.req_id, reason=reason)
         self.metrics.inc("requeues")
         _trace.instant("requeue", req=req.req_id, attempt=len(chain),
                        reason=reason)
@@ -663,7 +947,10 @@ class Fleet:
 
     @property
     def finished(self) -> dict:
-        out: dict = {}
+        # Requests ``restore`` reconstructed already-complete never pass
+        # through an engine again — they merge here so zero-lost
+        # accounting and ``check_invariants`` see them finished.
+        out: dict = dict(self._restored_finished)
         for rep in self.replicas:
             out.update(rep.engine.finished)
         return out
@@ -918,7 +1205,8 @@ class Fleet:
                                   + fm.get("requests_failed", 0.0))
         for k in ("requeues", "requeue_exhausted", "replica_quarantines",
                   "fleet_backpressure", "requests_routed",
-                  "replica_revives"):
+                  "replica_revives", "replica_spawns",
+                  "replica_retirements", "restored_requests"):
             out[k] = float(fm.get(k, 0.0))
         inc = self._incidents_block()
         if inc or any(getattr(rep.engine, "incidents", None) is not None

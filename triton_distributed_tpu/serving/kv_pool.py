@@ -162,6 +162,17 @@ class KVPool:
     def blocks_for(self, n_tokens: int) -> int:
         return blocks_needed(n_tokens, self.block_size)
 
+    def geometry(self) -> dict:
+        """JSON-safe pool geometry for checkpoint manifests
+        (resilience/checkpoint.py): restore validates the rebuilt fleet's
+        pools against this — the KV BYTES are never serialized (restored
+        requests recompute them via prefill), but mismatched geometry
+        would change admission/preemption decisions and break the
+        bit-identical-resume contract."""
+        return {"n_blocks": self.n_blocks, "block_size": self.block_size,
+                "max_seq_len": self.max_seq_len,
+                "max_blocks_per_seq": self.max_blocks_per_seq}
+
     def owned(self, seq_id) -> int:
         """Blocks currently owned by ``seq_id`` (0 if unknown)."""
         return len(self._tables.get(seq_id, ()))

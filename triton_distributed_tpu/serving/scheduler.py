@@ -87,6 +87,41 @@ class Request:
         everything generated before a preemption (eviction-by-recompute)."""
         return len(self.prompt) + len(self.output)
 
+    # -- checkpoint wire format (resilience/checkpoint.py) ------------------
+    # Host-side truth only: ``journey`` is deliberately excluded (a restored
+    # request begins a FRESH timeline with phase="restore" — hop causality
+    # across a crash is the journal's job, not the tracer's), and the
+    # monotonic timestamps are dropped (meaningless in the next process).
+
+    def to_wire(self) -> dict:
+        return {
+            "req_id": self.req_id,
+            "prompt": [int(t) for t in self.prompt],
+            "max_new_tokens": int(self.max_new_tokens),
+            "priority": int(self.priority),
+            "arrival_seq": self.arrival_seq,
+            "output": [int(t) for t in self.output],
+            "n_preemptions": int(self.n_preemptions),
+            "status": self.status,
+            "error": self.error,
+            "tenant": self.tenant,
+        }
+
+    @classmethod
+    def from_wire(cls, wire: dict) -> "Request":
+        return cls(
+            req_id=wire["req_id"],
+            prompt=list(wire["prompt"]),
+            max_new_tokens=wire["max_new_tokens"],
+            priority=wire.get("priority", 0),
+            arrival_seq=wire.get("arrival_seq"),
+            output=list(wire.get("output", ())),
+            n_preemptions=wire.get("n_preemptions", 0),
+            status=wire.get("status", "pending"),
+            error=wire.get("error"),
+            tenant=wire.get("tenant"),
+        )
+
 
 class Scheduler:
     """Priority-FIFO waiting queue + admission control + victim selection."""

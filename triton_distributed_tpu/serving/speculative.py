@@ -328,6 +328,44 @@ class SpecController:
         self._since_grow.pop(rid, None)
         self._last_dir.pop(rid, None)
 
+    # -- checkpoint wire format (resilience/checkpoint.py) ------------------
+
+    def snapshot(self) -> dict:
+        """JSON-safe adaptive-k state for ``Fleet.checkpoint``: the
+        per-request windows and widths plus the lifetime counters, so a
+        restored fleet keeps making the SAME k decisions (acceptance
+        evidence survives the crash exactly like it survives preemption —
+        see ``forget``'s rationale)."""
+        return {
+            "k_cap": self.k_cap,
+            "k": {str(r): k for r, k in self._k.items()},
+            "win": {str(r): [[p, a] for p, a in w]
+                    for r, w in self._win.items()},
+            "since_grow": {str(r): n for r, n in self._since_grow.items()},
+            "last_dir": {str(r): d for r, d in self._last_dir.items()},
+            "proposed": self.proposed, "accepted": self.accepted,
+            "verify_steps": self.verify_steps, "reversals": self.reversals,
+            "grows": self.grows, "shrinks": self.shrinks,
+        }
+
+    def restore(self, snap: dict) -> None:
+        self.k_cap = int(snap.get("k_cap", self.k_max))
+        self._k = {r: int(k) for r, k in snap.get("k", {}).items()}
+        self._win = {
+            r: collections.deque(((int(p), int(a)) for p, a in w),
+                                 maxlen=self.window)
+            for r, w in snap.get("win", {}).items()}
+        self._since_grow = {r: int(n)
+                            for r, n in snap.get("since_grow", {}).items()}
+        self._last_dir = {r: int(d)
+                          for r, d in snap.get("last_dir", {}).items()}
+        self.proposed = int(snap.get("proposed", 0))
+        self.accepted = int(snap.get("accepted", 0))
+        self.verify_steps = int(snap.get("verify_steps", 0))
+        self.reversals = int(snap.get("reversals", 0))
+        self.grows = int(snap.get("grows", 0))
+        self.shrinks = int(snap.get("shrinks", 0))
+
     @property
     def accept_rate(self) -> float:
         return self.accepted / self.proposed if self.proposed else 0.0

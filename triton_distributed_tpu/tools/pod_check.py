@@ -27,6 +27,15 @@ mode — becomes a loud exit 2 with a diagnostic snapshot on stderr within
 SECONDS, instead of a job that sits in the queue forever. That makes the
 tool safe to wire into an orchestrator liveness check.
 
+``--restore DIR`` verifies a fleet CHECKPOINT instead of the pod fabric:
+manifest presence + schema, state CRC, journal frame CRCs, and the
+manifest/journal sequence barrier (``resilience.checkpoint
+.verify_checkpoint``) — without building a fleet or touching a device.
+A torn journal tail is reported but tolerated (it heals on the next
+open); anything else exits 2, so an orchestrator can gate a restore
+attempt on it. Composes with ``--deadline`` (a hung filesystem read
+also exits 2, not the job queue).
+
 ``--fleet [N]`` probes the SERVING layer instead of the pod fabric:
 builds an N-replica ``serving.Fleet`` over a tiny model on this host's
 first device, drives a short request burst through it, and prints one
@@ -224,10 +233,70 @@ def main_fleet(n_replicas: int = 3, deadline_s: float | None = None) -> int:
     return 0
 
 
+def main_restore(ckpt_dir: str, deadline_s: float | None = None) -> int:
+    """Checkpoint health probe (``--restore DIR``): is this directory a
+    restorable fleet checkpoint? Exit 0 = manifest + state CRC + journal
+    frames all verify (a recoverable torn tail is only warned about);
+    exit 2 = missing/corrupt checkpoint or a journal truncated past the
+    manifest's sequence barrier — do NOT point ``Fleet.restore`` at it."""
+    import os
+
+    from triton_distributed_tpu.resilience import checkpoint as ckpt
+
+    wd = None
+    probe = contextlib.nullcontext()
+    if deadline_s is not None:
+        from triton_distributed_tpu.resilience import Watchdog
+
+        wd = Watchdog(on_breach="interrupt")
+        probe = wd.deadline("restore_probe", deadline_s)
+
+    try:
+        with probe:
+            problems = ckpt.verify_checkpoint(ckpt_dir)
+            jr = None
+            state, manifest = {}, {}
+            if not problems:
+                state, manifest = ckpt.load_checkpoint(
+                    ckpt_dir, check_fingerprint=False)
+                jpath = manifest.get("journal_path")
+                if jpath and not os.path.isabs(jpath):
+                    jpath = os.path.join(ckpt_dir, jpath)
+                if jpath and os.path.exists(jpath):
+                    jr = ckpt.read_journal(jpath)
+                    for warn in ckpt.verify_journal(jpath):
+                        # only torn-tail survives a clean verify_checkpoint
+                        log(f"warn: {warn}")
+    except BaseException as e:  # noqa: BLE001 — includes the interrupt
+        if wd is None or not wd.breaches:
+            raise
+        log(f"FAIL: deadline breached in restore probe "
+            f"({type(e).__name__})")
+        return 2
+
+    if problems:
+        for p in problems:
+            log(f"FAIL: {p}")
+        return 2
+    n_reqs = len(state.get("requests", {}))
+    barrier = manifest.get("journal_seq", -1)
+    suffix = (sum(r["seq"] > barrier for r in jr.records)
+              if jr is not None else 0)
+    log(f"checkpoint: {n_reqs} request(s) at step "
+        f"{state.get('n_steps', 0)}, journal barrier seq {barrier}"
+        + (f", {suffix} replayable suffix record(s)"
+           if jr is not None else ", no journal"))
+    log("CHECKPOINT RESTORABLE")
+    return 0
+
+
 if __name__ == "__main__":
     deadline = None
     if "--deadline" in sys.argv:
         deadline = float(sys.argv[sys.argv.index("--deadline") + 1])
+    if "--restore" in sys.argv:
+        sys.exit(main_restore(sys.argv[sys.argv.index("--restore") + 1],
+                              deadline))
     if "--fleet" in sys.argv:
         i = sys.argv.index("--fleet")
         n = (int(sys.argv[i + 1]) if i + 1 < len(sys.argv)
