@@ -1870,6 +1870,201 @@ def _bench_serve_crash(seed: int = 0) -> dict:
         shutil.rmtree(workdir, ignore_errors=True)
 
 
+# --- what-if replay arm (--serve --whatif) ---------------------------------
+
+
+def _bench_serve_whatif(seed: int = 0) -> dict:
+    """The ``--serve --whatif`` arm: the deterministic-replay gate.
+
+    Records a chaos+speculative serving run (replica 0 wedges mid-trace
+    and its requests requeue onto the survivor; drafts propose every
+    step) through the always-on ``ServeTrace`` with the prefill budget
+    deliberately throttled — the planted bottleneck. Gates, all strict:
+
+      * baseline replay through ``ReplayHarness`` is bit-identical to
+        the live run (same outputs, zero lost, zero retraces) even
+        though the replay fleet never sees the chaos schedule — faults
+        displace work, never change it;
+      * the counterfactual sweep ranks the planted strictly-better
+        config (full prefill budget) FIRST on goodput-under-SLO with a
+        positive delta;
+      * two independent sweeps of the same trace render byte-identical
+        markdown reports;
+      * recording overhead (trace on vs off, interleaved best-of-N so
+        drift cancels) <= 5% on real hardware, recorded off-TPU."""
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.replay import (
+        ReplayHarness,
+        WhatIfConfig,
+    )
+    from triton_distributed_tpu.resilience import faults
+    from triton_distributed_tpu.resilience.faults import (
+        default_fleet_chaos_plan,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import Fleet
+
+    devs, backend_err = _probe_backend()
+    if backend_err is not None:
+        raise backend_err
+    on_tpu = _tpu_like(devs)
+
+    config = ModelConfig.from_name("tiny")
+    mesh1 = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                      set_default=False)
+    engine = Engine(config, mesh=mesh1, mode="xla", block_n=8,
+                    key=jax.random.PRNGKey(0))
+    kw = dict(n_replicas=2, n_slots=3, n_blocks=16, block_size=4,
+              prefill_chunk=8, fail_threshold=2, speculative=True)
+    rng = np.random.default_rng(seed)
+    n_req = 14
+    specs = [(rng.integers(1, config.vocab_size,
+                           size=int(rng.integers(4, 13))).tolist(),
+              int(rng.integers(6, 11))) for _ in range(n_req)]
+    tenants = ("acme", "globex")
+
+    def build(donor=None, *, trace=True, throttle=True):
+        fleet = Fleet.build(engine, **kw, serve_trace=trace)
+        for rep in fleet.replicas:
+            if donor is not None:
+                rep.engine.share_steps_from(donor)
+            if throttle:
+                rep.engine.prefill_budget = 2   # the planted bottleneck
+        return fleet
+
+    def drive(fleet, tag, max_steps=3000):
+        """Step-anchored deterministic arrivals: request k submits the
+        first step after fleet step 2*k."""
+        k = 0
+        while k < n_req or not all(
+                rep.empty or rep.state == "DEAD"
+                for rep in fleet.replicas):
+            while k < n_req and 2 * k <= fleet.n_steps:
+                p, g = specs[k]
+                fleet.submit(p, g, req_id=f"{tag}-{k}",
+                             tenant=tenants[k % len(tenants)])
+                k += 1
+            fleet.step()
+            if fleet.n_steps > max_steps:
+                raise RuntimeError(f"whatif {tag} run did not settle")
+        if not fleet.check_invariants():
+            raise RuntimeError("fleet invariants violated")
+        if fleet.failed:
+            raise RuntimeError(
+                f"whatif arm failed requests: {sorted(fleet.failed)}")
+
+    # 1. Compile donor (clean, un-throttled): replays adopt its steps.
+    warm = build(trace=False, throttle=False)
+    drive(warm, "warm")
+    donor = warm.replicas[0].engine
+
+    # 2. The recorded run: chaos + speculative, prefill throttled.
+    live = build(donor)
+    plan = default_fleet_chaos_plan(seed, kill_replica=0, kill_after=5)
+    with faults.plan(plan):
+        drive(live, "live")
+    if not live._requeues:
+        raise RuntimeError("chaos kill displaced no requests — the "
+                           "recorded trace is not a chaos trace")
+    proposed = sum(rep.engine.metrics.counters.get(
+        "spec_proposed_tokens", 0.0) for rep in live.replicas)
+    if proposed <= 0:
+        raise RuntimeError("speculative fleet proposed no draft tokens")
+    trace = live.serve_trace.finalize(live)
+    survivor = live.replicas[1].engine
+
+    # 3. Baseline replay: bit-identical or the determinism contract broke.
+    harness = ReplayHarness(trace, donor=survivor)
+    base = harness.baseline()
+    if not base.matches_trace or base.lost or base.retraces:
+        raise RuntimeError(
+            f"baseline replay diverged from the recording "
+            f"(bit-identical={base.matches_trace}, lost={base.lost}, "
+            f"retraces={base.retraces})")
+
+    # 4. Counterfactual sweep: the planted config must win, strictly.
+    sweep_cfgs = [
+        WhatIfConfig(name="full-prefill", prefill_budget=8),
+        WhatIfConfig(name="one-replica", n_replicas=1),
+        WhatIfConfig(name="spec-k1", spec_k_cap=1),
+    ]
+    report = harness.sweep(sweep_cfgs)
+    win = report.winner()
+    if win is None or win["name"] != "full-prefill":
+        raise RuntimeError(
+            f"planted strictly-better config did not rank first: "
+            f"winner={win['name'] if win else None}")
+    if win["d_goodput"] <= 0.0:
+        raise RuntimeError(
+            f"planted config is not strictly better "
+            f"(d_goodput={win['d_goodput']:.6f})")
+
+    # 5. Report determinism: an independent harness over the same trace
+    # must render byte-identical markdown.
+    harness2 = ReplayHarness(trace, donor=survivor)
+    report2 = harness2.sweep(sweep_cfgs)
+    md1, md2 = report.to_markdown(), report2.to_markdown()
+    if md1 != md2:
+        raise RuntimeError("what-if report is not byte-identical across "
+                           "two sweeps of the same trace")
+
+    # 6. Recording overhead: trace on vs off, clean workload, interleaved
+    # best-of-N (noise is one-sided — the min is the least-contended
+    # estimate). Gated <= 5% on real hardware only.
+    def timed(with_trace):
+        fleet = build(donor, trace=with_trace, throttle=False)
+        t0 = time.perf_counter()
+        for rep_i in range(2):
+            for i, (p, g) in enumerate(specs):
+                fleet.submit(p, g, req_id=f"o{rep_i}-{i}",
+                             tenant=tenants[i % len(tenants)])
+        fleet.run(max_steps=5000)
+        dt = time.perf_counter() - t0
+        if len(fleet.finished) != 2 * n_req:
+            raise RuntimeError("overhead trial lost requests")
+        return dt
+
+    rounds = 6 if on_tpu else 3
+    t_on, t_off = [], []
+    for _ in range(rounds):
+        t_off.append(timed(False))
+        t_on.append(timed(True))
+    s_off, s_on = min(t_off), min(t_on)
+    overhead = max(0.0, s_on / s_off - 1.0)
+    ok = (overhead <= 0.05) or not on_tpu
+    extras = {
+        "serve_whatif_off_s": round(s_off, 6),
+        "serve_whatif_on_s": round(s_on, 6),
+        "whatif_overhead_ok": ok,
+        "whatif_overhead_gated": on_tpu,
+        "whatif_baseline_bit_identical": bool(base.matches_trace),
+        "whatif_report_identical": True,
+        "whatif_lost_requests": int(base.lost),
+        "whatif_retraces": int(base.retraces),
+        "whatif_replay_steps": int(base.n_steps),
+        "whatif_baseline_goodput": round(report.baseline["goodput"], 6),
+        "whatif_winner_goodput": round(win["goodput"], 6),
+        "whatif_goodput_delta": round(win["d_goodput"], 6),
+        "whatif_planted_first_ok": True,
+        "whatif_requests": n_req,
+        "whatif_configs": len(sweep_cfgs),
+        "whatif_calib_samples": int(trace._n_samples),
+    }
+    if not ok:
+        raise RuntimeError(
+            f"serve-trace recording overhead {overhead:.1%} exceeds the "
+            f"5% budget (off={s_off:.4f}s on={s_on:.4f}s)")
+    return {
+        "backend": jax.devices()[0].platform,
+        "metric": "whatif_overhead_frac",
+        "value": round(overhead, 4),
+        "unit": "frac",
+        "extras": extras,
+    }
+
+
 def main():
     import sys
 
@@ -1932,7 +2127,9 @@ def main():
         with_incidents = "--incidents" in sys.argv
         with_spec = "--spec" in sys.argv
         with_crash = "--crash" in sys.argv
-        metric = ("journal_overhead_frac" if with_crash
+        with_whatif = "--whatif" in sys.argv
+        metric = ("whatif_overhead_frac" if with_whatif
+                  else "journal_overhead_frac" if with_crash
                   else "spec_goodput_under_slo" if with_spec
                   else "goodput_under_slo" if adaptive
                   else "obs_overhead_frac" if with_slo
@@ -1941,7 +2138,10 @@ def main():
                   else "incidents_overhead_frac" if with_incidents
                   else "prefix_hit_rate")
         try:
-            if with_crash:
+            if with_whatif:
+                result = _bench_serve_whatif(
+                    seed=int(_arg_after(sys.argv, "--whatif-seed", 0)))
+            elif with_crash:
                 result = _bench_serve_crash(
                     seed=int(_arg_after(sys.argv, "--crash-seed", 0)))
             elif with_spec:
@@ -1968,7 +2168,8 @@ def main():
             }
         print(json.dumps(result))
         _record_perfdb(result, perfdb_path,
-                       suite=("serve_crash" if with_crash
+                       suite=("serve_whatif" if with_whatif
+                              else "serve_crash" if with_crash
                               else "serve_spec" if with_spec
                               else "serve_adaptive" if adaptive
                               else "serve_slo" if with_slo

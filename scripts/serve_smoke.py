@@ -37,6 +37,13 @@ journaled fleet, a mid-flight checkpoint, a simulated power cut
 steps. FAILS unless zero requests are lost, at least one request
 finishes after the restore, and no replica retraces.
 
+``--whatif`` runs the deterministic-replay arm (obs/replay.py): a short
+discretized-Poisson fleet run is recorded by the always-on ``ServeTrace``,
+the baseline replay through ``ReplayHarness`` must be bit-identical to
+the live run (same outputs, zero lost, zero retraces, ``trace_counts``
+{1,1}), and one counterfactual (full prefill budget vs the run's
+throttled one) must produce a ranked what-if report.
+
 ``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
 N replicas behind the cache/SLO-aware router. Plain run: everything
 completes, no replica leaves the ROUTABLE states, every replica's two
@@ -691,6 +698,121 @@ def main_incidents(*, seed: int = 0, warmup: int = 32,
     return result
 
 
+def main_whatif(*, seed: int = 0, n_requests: int = 10,
+                perfdb_path: str | None = None) -> dict:
+    """The ``--whatif`` arm: record -> replay -> counterfactual.
+
+    A 2-replica tiny-model fleet with its prefill budget throttled
+    serves a short discretized-Poisson workload (geometric inter-arrival
+    gaps in fleet STEPS, so the arrival process is Poisson-like yet
+    fully deterministic for a seed) while the always-on ``ServeTrace``
+    records it. The gate: the baseline replay through ``ReplayHarness``
+    is bit-identical to the live run (same outputs, zero lost requests,
+    zero retraces), and one counterfactual — the un-throttled prefill
+    budget — produces a ranked ``WhatIfReport``. Raises RuntimeError on
+    any violation."""
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.replay import (
+        ReplayHarness,
+        WhatIfConfig,
+    )
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving.fleet import Fleet
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                     set_default=False)
+    config = ModelConfig.from_name("tiny")
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    fleet = Fleet.build(engine, n_replicas=2, n_slots=4, n_blocks=24,
+                        block_size=4, prefill_chunk=8, seed=seed)
+    if fleet.serve_trace is None:
+        raise RuntimeError("ServeTrace not attached — recording must be "
+                           "always-on by default")
+    for rep in fleet.replicas:
+        rep.engine.prefill_budget = 2   # the counterfactual lifts this
+    rng = np.random.default_rng(seed)
+    # Discretized Poisson: geometric step gaps at ~1 arrival / 2 steps.
+    arrive_at, step_at = [], 0
+    for _ in range(n_requests):
+        arrive_at.append(step_at)
+        step_at += int(rng.geometric(0.5))
+    start = time.monotonic()
+    k = 0
+    while k < n_requests or not all(
+            rep.empty or rep.state == "DEAD" for rep in fleet.replicas):
+        while k < n_requests and arrive_at[k] <= fleet.n_steps:
+            n = int(rng.integers(4, 14))
+            prompt = rng.integers(1, config.vocab_size, size=n).tolist()
+            fleet.submit(prompt, 6, tenant=("acme", "globex")[k % 2])
+            k += 1
+        fleet.step()
+        if fleet.n_steps > 2000:
+            raise RuntimeError("whatif arm run did not settle")
+    if not fleet.check_invariants():
+        raise RuntimeError("fleet invariants violated")
+    trace = fleet.serve_trace.finalize(fleet)
+    if len(trace.arrivals) != n_requests:
+        raise RuntimeError(
+            f"trace recorded {len(trace.arrivals)} arrivals, expected "
+            f"{n_requests}")
+
+    harness = ReplayHarness(trace, donor=fleet.replicas[0].engine)
+    base = harness.baseline()
+    if not base.matches_trace or base.lost or base.retraces:
+        raise RuntimeError(
+            f"baseline replay diverged from the recording "
+            f"(bit-identical={base.matches_trace}, lost={base.lost}, "
+            f"retraces={base.retraces})")
+    report = harness.sweep([
+        WhatIfConfig(name="full-prefill", prefill_budget=8),
+    ])
+    win = report.winner()
+    if win is None:
+        raise RuntimeError("counterfactual sweep produced no ranked row")
+    if win["lost"]:
+        raise RuntimeError(f"counterfactual lost {win['lost']} requests")
+    md = report.to_markdown()
+    if "full-prefill" not in md:
+        raise RuntimeError("what-if report is missing the counterfactual")
+
+    result = {
+        "requests_submitted": n_requests,
+        "requests_completed": len(fleet.finished),
+        "requests_failed": len(fleet.failed),
+        "wall_s": round(time.monotonic() - start, 3),
+        "whatif_baseline_bit_identical": True,
+        "whatif_lost_requests": int(base.lost),
+        "whatif_retraces": int(base.retraces),
+        "whatif_baseline_goodput": round(report.baseline["goodput"], 6),
+        "whatif_winner_goodput": round(win["goodput"], 6),
+        "whatif_goodput_delta": round(win["d_goodput"], 6),
+        "whatif_calib_samples": int(trace._n_samples),
+        "cost_model_source": harness.cost.source,
+        "trace_count_decode": max(rep.engine.trace_counts["decode"]
+                                  for rep in fleet.replicas),
+        "trace_count_prefill": max(rep.engine.trace_counts["prefill"]
+                                   for rep in fleet.replicas),
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = fleet.perfdb_sample()
+        sample["whatif_baseline_goodput"] = float(
+            report.baseline["goodput"])
+        sample["whatif_winner_goodput"] = float(win["goodput"])
+        sample["whatif_goodput_delta"] = float(win["d_goodput"])
+        sample["whatif_lost_requests"] = float(base.lost)
+        sample["whatif_retraces"] = float(base.retraces)
+        sample["whatif_calib_samples"] = float(trace._n_samples)
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_whatif", metrics=sample,
+            meta={"seed": seed, "n_requests": n_requests})
+        result["perfdb_run_id"] = rec.run_id
+    return result
+
+
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
@@ -909,6 +1031,10 @@ if __name__ == "__main__":
                          "through spec and plain engines; assert zero "
                          "output divergence, nonzero accepted drafts, "
                          "zero retraces")
+    ap.add_argument("--whatif", action="store_true",
+                    help="run the deterministic-replay arm: record a "
+                         "short run, replay the baseline bit-identical, "
+                         "produce one counterfactual what-if report")
     ap.add_argument("--restore", action="store_true",
                     help="run the crash-recovery arm: journaled Poisson "
                          "load, checkpoint, simulated power cut, "
@@ -919,7 +1045,16 @@ if __name__ == "__main__":
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.restore:
+        if args.whatif:
+            if (args.chaos or args.adaptive or args.spec
+                    or args.incidents or args.restore
+                    or args.replicas > 1):
+                raise SystemExit("--whatif is its own arm; run it "
+                                 "without --chaos/--adaptive/--spec/"
+                                 "--incidents/--restore/--replicas")
+            metrics = main_whatif(seed=args.seed,
+                                  perfdb_path=args.perfdb)
+        elif args.restore:
             if args.chaos or args.adaptive or args.spec or args.incidents:
                 raise SystemExit("--restore is its own arm; run it "
                                  "without --chaos/--adaptive/--spec/"

@@ -490,3 +490,37 @@ def test_pod_check_restore_probe(tmp_path):
     state.write_bytes(bytes(blob))
     assert pod_check.main_restore(ck) == 2
     assert pod_check.main_restore(str(tmp_path / "nope")) == 2
+
+
+# -- schema-2 submit frames (ISSUE 19) ---------------------------------------
+
+
+def test_submit_frame_carries_arrival_stamp(setup, tmp_path):
+    """Schema 2: every fleet submit frame persists the billing tenant
+    and the arrival stamp (wall clock + fleet step index) so post-hoc
+    tools (ServeTrace.from_journal, explain_request --journal) can
+    reconstruct the arrival process without a live fleet."""
+    _, config, engine = setup
+    fleet = Fleet.build(engine, n_replicas=1, n_slots=2, n_blocks=16,
+                        block_size=4, prefill_chunk=8)
+    path = str(tmp_path / "journal.jsonl")
+    fleet.attach_journal(path)
+    fleet.submit([1, 2, 3], 3, tenant="acme")
+    for _ in range(4):
+        fleet.step()
+    fleet.submit([4, 5], 2, tenant="globex")
+    fleet.step()                              # route the pending request
+    while not all(rep.empty or rep.state == DEAD
+                  for rep in fleet.replicas):
+        fleet.step()
+    fleet.journal.close()
+    subs = [r for r in read_journal(path).records if r["kind"] == "submit"]
+    assert [s["tenant"] for s in subs] == ["acme", "globex"]
+    assert subs[0]["arrival_step"] == 0
+    assert subs[1]["arrival_step"] >= 4       # stamped at the live clock
+    assert all(isinstance(s["arrival_t"], float) for s in subs)
+    assert subs[0]["arrival_t"] <= subs[1]["arrival_t"]
+    # Back-compat read: replay_requests never requires the new fields.
+    reqs = replay_requests(read_journal(path).records)
+    assert {r for r in reqs} == {s["req_id"] for s in subs}
+    assert all(w["status"] == "ok" for w in reqs.values())

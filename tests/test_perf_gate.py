@@ -357,3 +357,95 @@ def test_roofline_metric_class_families():
     assert roofline.metric_class("flash_decode_hbm_frac") == "hbm"
     assert roofline.metric_class("serve_ttft_p95_ms") == "serving"
     assert roofline.metric_class("completely_novel_thing") == "unknown"
+
+
+# ---------------------------------------------------------------------------
+# Trend (perfdb.trend + perf_gate --trend) — informational drift table
+# ---------------------------------------------------------------------------
+
+
+def test_trend_flags_are_direction_aware(tmp_path):
+    """Signed delta convention matches compare(): positive ALWAYS means
+    drifting worse, so a lower-better metric ramping UP and a
+    higher-better metric ramping DOWN both flag drifting-worse, while a
+    throughput ramping UP flags drifting-better."""
+    path = tmp_path / "perf.jsonl"
+    metrics_list = []
+    for i in range(8):
+        metrics_list.append({
+            "gemm_ms": 1.0 + 0.2 * i,              # lower-better, rising
+            "serve_tokens_per_s": 50.0 + 10.0 * i,  # higher-better, rising
+            "steady_ms": 2.0,                       # flat
+            "whatif_requests": 10.0 + i,            # declared context-only
+        })
+    db = _seed_db(path, metrics_list)
+    rows = db.trend(suite="bench")
+    by = {r["metric"]: r for r in rows}
+    assert by["gemm_ms"]["flag"] == "drifting-worse"
+    assert by["gemm_ms"]["delta_frac"] > 0.08
+    assert by["serve_tokens_per_s"]["flag"] == "drifting-better"
+    assert by["serve_tokens_per_s"]["delta_frac"] < -0.08
+    assert by["steady_ms"]["flag"] == "flat"
+    assert by["steady_ms"]["delta_frac"] == 0.0
+    assert by["whatif_requests"]["flag"] == "context"
+    assert by["whatif_requests"]["direction"] == 0
+    # Severity order: regressions render first.
+    flags = [r["flag"] for r in rows]
+    assert flags == ["drifting-worse", "drifting-better", "flat",
+                     "context"]
+    assert by["gemm_ms"]["n"] == 8
+    assert by["gemm_ms"]["first"] == 1.0
+    assert by["gemm_ms"]["last"] == pytest.approx(2.4)
+
+
+def test_trend_sparse_and_overhead_slack(tmp_path):
+    """Metrics with fewer than TREND_MIN_RUNS samples report sparse (no
+    half-split anchors); overhead fractions inside the absolute budget
+    slack stay flat even when relative drift is large."""
+    path = tmp_path / "perf.jsonl"
+    metrics_list = [{"gemm_ms": 1.0,
+                     "whatif_overhead_frac": 0.001 * (i + 1)}
+                    for i in range(6)]
+    metrics_list[-1]["late_ms"] = 9.0       # only 1 sample
+    db = _seed_db(path, metrics_list)
+    by = {r["metric"]: r for r in db.trend()}
+    assert by["late_ms"]["flag"] == "sparse"
+    assert by["late_ms"]["n"] == 1
+    assert by["late_ms"]["anchor_old"] is None
+    # 0.001 -> 0.006 is a 6x relative rise but far inside the ±0.05
+    # absolute overhead budget: flat, same convention as the gate.
+    assert by["whatif_overhead_frac"]["flag"] == "flat"
+
+
+def test_gate_trend_cli_informational_exit0(tmp_path, capsys):
+    """--trend renders the drift table and ALWAYS exits 0 — trend
+    informs, gate gates."""
+    path = tmp_path / "perf.jsonl"
+    _seed_db(path, [{"gemm_ms": 1.0 + 0.3 * i} for i in range(6)])
+    report_file = tmp_path / "trend.md"
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench",
+                         "--trend", "--report", str(report_file)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "# Perf trend report" in out
+    assert "**drifting-worse**" in out
+    assert "metric(s) drifting worse" in out
+    assert report_file.read_text() in out
+
+
+def test_gate_trend_filters_foreign_fingerprints(tmp_path, capsys):
+    """Trend compares only runs comparable with the newest fingerprint —
+    a v5e sample in a cpu history is a category error here too."""
+    path = tmp_path / "perf.jsonl"
+    db = pdb.PerfDB(str(path))
+    for i in range(4):
+        db.append(suite="bench", metrics={"gemm_ms": 5.0},
+                  fingerprint_=dict(FP_OTHER), ts=100.0 + i)
+    for i in range(4):
+        db.append(suite="bench", metrics={"gemm_ms": 1.0},
+                  fingerprint_=dict(FP), ts=200.0 + i)
+    rc = perf_gate.main(["--db", str(path), "--suite", "bench", "--trend"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "4 comparable run(s)" in out      # the foreign half dropped
+    assert "no metric drifting worse" in out

@@ -6,6 +6,8 @@ import importlib.util
 import json
 import pathlib
 
+import pytest
+
 _SCRIPT = pathlib.Path(__file__).parent.parent / "scripts" / "serve_smoke.py"
 
 
@@ -203,6 +205,34 @@ def test_serve_smoke_chaos():
     assert m["trace_count_prefill"] == 1
     # the fault plane actually exercised the retry path
     assert m.get("step_retries", 0) + m.get("alloc_retries", 0) > 0
+
+
+def test_serve_smoke_whatif(tmp_path):
+    """The --whatif contract (ISSUE 19): a short discretized-Poisson run
+    is recorded by the always-on ServeTrace, the baseline replay through
+    ReplayHarness is bit-identical (zero lost, zero retraces), and the
+    planted full-prefill counterfactual produces a ranked report with a
+    strictly positive goodput delta (main_whatif raises on any violation
+    — this test runs that contract under tier 1 and pins the perfdb
+    keys)."""
+    db = tmp_path / "perf.jsonl"
+    m = _load().main_whatif(seed=0, n_requests=6, perfdb_path=str(db))
+    assert m["requests_completed"] == m["requests_submitted"] == 6
+    assert m["requests_failed"] == 0
+    assert m["whatif_baseline_bit_identical"] is True
+    assert m["whatif_lost_requests"] == 0
+    assert m["whatif_retraces"] == 0
+    assert m["whatif_goodput_delta"] > 0.0
+    assert (m["whatif_winner_goodput"]
+            == pytest.approx(m["whatif_baseline_goodput"]
+                             + m["whatif_goodput_delta"], abs=2e-6))
+    assert m["cost_model_source"] in ("stock", "calibrated")
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+    rec = json.loads(db.read_text().strip().splitlines()[-1])
+    assert rec["suite"] == "serve_smoke_whatif"
+    assert rec["metrics"]["whatif_lost_requests"] == 0
+    assert rec["metrics"]["whatif_goodput_delta"] > 0.0
 
 
 def test_serve_smoke_incidents(tmp_path):

@@ -14,6 +14,13 @@ violation is exit 1, not a warning).
     python tools/explain_request.py --journal dump.json --req req-3
     python tools/explain_request.py --journal dump.json --slowest
 
+    # post-hoc, from a fleet journal DIRECTORY (no live fleet): prefers
+    # DIR/journeys.json (full journey forensics) and falls back to the
+    # write-ahead log DIR/journal.jsonl — frame-ordered lifecycle
+    # timeline, tenant + schema-2 arrival stamp, displacement chain;
+    # DIR/stats.json (a stats snapshot), when present, is appended
+    python tools/explain_request.py --journal serve_dir/ --req req-3
+
     # self-contained deterministic demo: tiny fleet + seeded chaos kill,
     # virtual step clock -> byte-identical report per seed
     python tools/explain_request.py --chaos --seed 0
@@ -263,6 +270,114 @@ def explain_from_journal(path: str, *, req_id: str | None,
     return _restitch(jd)
 
 
+# -- journal-directory mode --------------------------------------------------
+
+def _wal_render(dirpath: str, records: list, req_id: str,
+                stats: dict | None) -> str:
+    """Forensic markdown for one request straight off the write-ahead
+    log: frame-ordered lifecycle (submit -> admit -> emit... -> finish /
+    fail, requeues in place), the schema-2 arrival stamp + tenant tag,
+    and the stats snapshot when one was dumped next to the WAL. Coarser
+    than journey forensics (the WAL has no per-hop timings or route
+    scores) but requires nothing beyond what crash recovery already
+    persists."""
+    frames = [r for r in records if r.get("req_id") == req_id]
+    if not frames:
+        have = sorted({str(r["req_id"]) for r in records
+                       if r.get("kind") == "submit"})
+        raise LookupError(
+            f"{dirpath}: request {req_id!r} not in the journal "
+            f"(have: {', '.join(have[:8])}"
+            f"{'...' if len(have) > 8 else ''})")
+    sub = next((r for r in frames if r["kind"] == "submit"), None)
+    emits = [r for r in frames if r["kind"] == "emit"]
+    requeues = [r for r in frames if r["kind"] == "requeue"]
+    status, error = "pending", None
+    for r in frames:
+        if r["kind"] == "finish":
+            status = "ok"
+        elif r["kind"] == "fail":
+            status, error = "failed", r.get("error")
+    lines = [
+        f"# explain_request (journal): {req_id}", "",
+        "| field | value |", "|---|---|",
+        f"| status | {status}" + (f" ({error})" if error else "") + " |",
+        f"| tenant | {(sub or {}).get('tenant') or '-'} |",
+        f"| arrival step | {(sub or {}).get('arrival_step', '-')} |",
+        f"| arrival t | {(sub or {}).get('arrival_t', '-')} |",
+        f"| prompt tokens | {len((sub or {}).get('prompt', ()))} |",
+        f"| emitted tokens | {len(emits)} |",
+        f"| requeues | {len(requeues)} |",
+        f"| journal frames | {len(frames)} |",
+        "",
+        "## Frame timeline", "",
+        "| seq | kind | detail |", "|---:|---|---|",
+    ]
+    skip = ("seq", "kind", "req_id", "prompt")
+    for r in frames:
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(r.items())
+                           if k not in skip)
+        lines.append(f"| {r.get('seq', '-')} | {r['kind']} | {detail} |")
+    lines.append("")
+    if requeues:
+        lines.append(f"displacement chain: {len(requeues)} requeue(s) — "
+                     + "; ".join(str(r.get("reason", "?"))
+                                 for r in requeues))
+        lines.append("")
+    lines.append("(WAL-only forensics: per-hop timings, route scores and "
+                 "latency attribution need a journeys.json dump next to "
+                 "the journal)")
+    lines.append("")
+    if stats:
+        lines += ["## Stats snapshot", "", "| key | value |", "|---|---|"]
+        for k in sorted(stats):
+            v = stats[k]
+            if isinstance(v, (int, float, str, bool)):
+                lines.append(f"| {k} | {v} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def explain_from_journal_dir(dirpath: str, *, req_id: str | None,
+                             slowest: bool):
+    """Forensics off a journal directory with no live fleet: returns
+    either a ``Journey`` (``journeys.json`` present — full report through
+    the normal render path) or a ready markdown string (WAL fallback).
+    ``--slowest`` against the bare WAL picks the request with the most
+    emitted tokens (the WAL carries no wall-clock latencies)."""
+    from triton_distributed_tpu.resilience.checkpoint import (
+        JOURNAL_NAME,
+        read_journal,
+    )
+
+    journeys_path = os.path.join(dirpath, "journeys.json")
+    if os.path.exists(journeys_path):
+        return explain_from_journal(journeys_path, req_id=req_id,
+                                    slowest=slowest)
+    wal_path = os.path.join(dirpath, JOURNAL_NAME)
+    if not os.path.exists(wal_path):
+        raise LookupError(
+            f"{dirpath}: neither journeys.json nor {JOURNAL_NAME} found "
+            "— not a journal directory")
+    records = read_journal(wal_path).records
+    stats = None
+    stats_path = os.path.join(dirpath, "stats.json")
+    if os.path.exists(stats_path):
+        with open(stats_path, encoding="utf-8") as f:
+            stats = json.load(f)
+    if slowest:
+        n_emits: dict = {}
+        for r in records:
+            if r.get("kind") == "emit":
+                n_emits[str(r["req_id"])] = \
+                    n_emits.get(str(r["req_id"]), 0) + 1
+        if not n_emits:
+            raise LookupError(f"{wal_path}: no emit frames — nothing "
+                              "to rank for --slowest")
+        req_id = max(sorted(n_emits), key=lambda k: n_emits[k])
+    return _wal_render(dirpath, records, str(req_id), stats)
+
+
 # -- chaos demo mode ---------------------------------------------------------
 
 class _StepClock:
@@ -359,10 +474,14 @@ def main(argv=None) -> int:
     try:
         if args.chaos:
             j = run_chaos_demo(args.seed, dump_path=args.dump_journal)
+        elif os.path.isdir(args.journal):
+            j = explain_from_journal_dir(args.journal, req_id=args.req,
+                                         slowest=args.slowest)
         else:
             j = explain_from_journal(args.journal, req_id=args.req,
                                      slowest=args.slowest)
-        check_fractions(j)
+        if isinstance(j, Journey):
+            check_fractions(j)
     except (OSError, json.JSONDecodeError) as e:
         sys.stderr.write(f"explain_request: {e}\n")
         return 2
@@ -370,7 +489,7 @@ def main(argv=None) -> int:
         sys.stderr.write(f"explain_request: {e}\n")
         return 1
 
-    report = render(j) + "\n"
+    report = (render(j) if isinstance(j, Journey) else j) + "\n"
     if args.out:
         with open(args.out, "w", encoding="utf-8") as f:
             f.write(report)

@@ -26,6 +26,12 @@ Ingest formats (auto-detected per file, last parseable JSON line wins —
 matching bench.py's one-JSON-line stdout contract):
   - bench.py:       {"metric": ..., "value": ..., "extras": {...}}
   - serve_smoke.py: flat metrics dict
+
+``--trend`` renders the per-metric drift table across the WHOLE recorded
+history instead of gating head-vs-base (``perfdb.trend()``: older-half vs
+newer-half robust anchors, direction-aware drifting-worse/-better/flat
+flags) — the BENCH_r*.json trajectory as a readable table. Informational
+only: always exit 0.
 """
 
 from __future__ import annotations
@@ -141,6 +147,47 @@ def render_report(verdicts, *, head, n_base: int, tolerance: float) -> str:
     return "\n".join(lines)
 
 
+def render_trend(rows: list[dict], *, suite: str | None, n_runs: int,
+                 tolerance: float) -> str:
+    """Markdown drift table for one ``perfdb.trend()`` result."""
+    arrow = {-1: "lower", 1: "higher", 0: "?"}
+
+    def fmt(v):
+        return "—" if v is None else f"{v:.6g}"
+
+    lines = [
+        "# Perf trend report",
+        "",
+        f"suite: `{suite or 'all'}` — {n_runs} comparable run(s), "
+        f"older-half vs newer-half robust anchors, drift flagged past "
+        f"±{tolerance * 100:.1f}%",
+        "",
+        "| metric | better | n | first | last | old anchor | new anchor |"
+        " Δ (+ = worse) | flag |",
+        "|---|---|---:|---:|---:|---:|---:|---:|---|",
+    ]
+    for r in rows:
+        delta = ("—" if r["delta_frac"] is None
+                 else f"{r['delta_frac'] * 100:+.1f}%")
+        flag = ("**drifting-worse**" if r["flag"] == "drifting-worse"
+                else r["flag"])
+        lines.append(
+            f"| `{r['metric']}` | {arrow[r['direction']]} | {r['n']} |"
+            f" {fmt(r['first'])} | {fmt(r['last'])} |"
+            f" {fmt(r['anchor_old'])} | {fmt(r['anchor_new'])} |"
+            f" {delta} | {flag} |")
+    lines.append("")
+    worse = [r for r in rows if r["flag"] == "drifting-worse"]
+    if worse:
+        lines.append(f"**{len(worse)} metric(s) drifting worse** across "
+                     "the recorded history — informational, not gated.")
+    else:
+        lines.append("no metric drifting worse across the recorded "
+                     "history.")
+    lines.append("")
+    return "\n".join(lines)
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description=__doc__.splitlines()[0],
@@ -163,6 +210,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="also write the markdown report to this path")
     ap.add_argument("--no-gate", action="store_true",
                     help="ingest/record only; skip the comparison")
+    ap.add_argument("--trend", action="store_true",
+                    help="render the per-metric drift table across the "
+                         "recorded history instead of gating "
+                         "(informational, exit 0)")
     ap.add_argument("--allow-fingerprint-mismatch", action="store_true",
                     help="compare across environments anyway (labels only)")
     args = ap.parse_args(argv)
@@ -188,6 +239,24 @@ def main(argv: list[str] | None = None) -> int:
         _err(f"perf_gate: skipped {db.skipped_lines} corrupt db line(s)")
     if not runs:
         _err("perf_gate: empty database — nothing to gate")
+        return 0
+
+    if args.trend:
+        # Drift across the history, not head-vs-base: filter to runs
+        # comparable with the newest one (a v5e sample in a cpu history
+        # is a category error here too), then hand the ordered sequence
+        # to perfdb.trend(). Always exit 0 — trend informs, gate gates.
+        if not args.allow_fingerprint_mismatch:
+            runs = [r for r in runs
+                    if pdb.comparable(r.fingerprint, runs[-1].fingerprint)]
+        metrics = (args.metrics.split(",") if args.metrics else None)
+        rows = pdb.trend(runs, tolerance=args.tolerance, metrics=metrics)
+        report = render_trend(rows, suite=args.suite, n_runs=len(runs),
+                              tolerance=args.tolerance)
+        _out(report)
+        if args.report:
+            with open(args.report, "w", encoding="utf-8") as f:
+                f.write(report)
         return 0
     head_runs = runs[-max(args.head, 1):]
     head = head_runs[-1]

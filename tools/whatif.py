@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""whatif: counterfactual serving analysis from a recorded ServeTrace.
+
+Front-end for ``obs/replay.py``: replay a recorded serving run through
+the REAL Fleet/BatchEngine in deterministic virtual time, baseline
+first (must be bit-identical — same outputs, zero lost, zero
+retraces), then under altered configs, and render the ranked
+``WhatIfReport`` as markdown.
+
+    # self-contained deterministic demo: record a throttled tiny-fleet
+    # run, replay it under counterfactual knobs -> byte-identical
+    # report per seed
+    python tools/whatif.py --demo --seed 0
+
+    # offline, from a PR 18 write-ahead journal (file or the fleet's
+    # journal directory): reconstruct the arrival process + golden
+    # outputs without a live fleet and summarize per-tenant
+    python tools/whatif.py --journal serve_journal/
+
+The ``--demo`` mode builds a 2-replica tiny-model fleet with the
+prefill budget deliberately throttled, swaps each replica's efficiency
+ledger onto a virtual step clock (so the recorded cost-model
+calibration is reproducible), records a deterministic step-anchored
+workload, then sweeps: full prefill budget (the planted strictly-better
+config), a single-replica fleet, and prefix cache off. Exit 0 clean;
+1 when the baseline replay diverges from the recording (determinism
+contract broken) or the analysis fails; 2 on usage/IO errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:  # runnable as `python tools/whatif.py`
+    sys.path.insert(0, _REPO_ROOT)
+
+from triton_distributed_tpu.obs.replay import (  # noqa: E402
+    ServeTrace,
+    WhatIfConfig,
+)
+
+
+class _VtClock:
+    """Virtual clock for the recording fleet's efficiency ledgers: each
+    read advances one fixed tick, so the ledger's accounted per-step
+    seconds — and therefore the calibrated cost-model coefficients the
+    trace carries — are byte-identical across runs of the same seed."""
+
+    def __init__(self, tick: float = 1e-3):
+        self.n = 0
+        self.tick = tick
+
+    def __call__(self) -> float:
+        self.n += 1
+        return self.n * self.tick
+
+
+# -- demo mode ---------------------------------------------------------------
+
+def run_demo(seed: int):
+    """Record a throttled deterministic run, then sweep counterfactuals.
+
+    Returns ``(baseline ReplayResult, WhatIfReport)``. The recording
+    fleet is stepped on a fixed arrival schedule (request k submits at
+    fleet step 3*k), so the trace — and every virtual-time replay of
+    it — is a pure function of the seed."""
+    import jax                                    # deferred: heavy
+    import numpy as np
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.obs.efficiency import EfficiencyLedger
+    from triton_distributed_tpu.obs.replay import ReplayHarness
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving.fleet import Fleet
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1],
+                     set_default=False)
+    config = ModelConfig.from_name("tiny")
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    fleet = Fleet.build(engine, n_replicas=2, n_slots=4, n_blocks=24,
+                        block_size=4, prefill_chunk=8, seed=seed)
+    for rep in fleet.replicas:
+        # Deterministic calibration (see _VtClock); one clock per
+        # ledger so per-replica read counts don't interleave.
+        rep.engine.efficiency = EfficiencyLedger(clock=_VtClock())
+        # The deliberate bottleneck the planted counterfactual lifts.
+        rep.engine.prefill_budget = 2
+
+    rng = np.random.default_rng(seed)
+    tenants = ("acme", "globex")
+    n_requests = 10
+    arrive_at = [3 * k for k in range(n_requests)]
+    k = 0
+    while k < n_requests or not all(
+            rep.empty or rep.state == "DEAD" for rep in fleet.replicas):
+        while k < n_requests and arrive_at[k] <= fleet.n_steps:
+            n = int(rng.integers(4, 16))
+            prompt = rng.integers(1, config.vocab_size, size=n).tolist()
+            fleet.submit(prompt, 6, tenant=tenants[k % len(tenants)])
+            k += 1
+        fleet.step()
+        if fleet.n_steps > 2000:
+            raise RuntimeError("demo recording run did not settle")
+    fleet.check_invariants()
+    trace = fleet.serve_trace.finalize(fleet)
+
+    harness = ReplayHarness(trace, donor=fleet.replicas[0].engine)
+    base = harness.baseline()
+    report = harness.sweep([
+        WhatIfConfig(name="full-prefill", prefill_budget=8),
+        WhatIfConfig(name="one-replica", n_replicas=1),
+        WhatIfConfig(name="no-prefix-cache", prefix_cache=False),
+    ])
+    return base, report
+
+
+# -- journal mode ------------------------------------------------------------
+
+def summarize_journal(path: str) -> str:
+    """Markdown reconstruction of the arrival process + golden outcome
+    from a write-ahead journal alone (no live fleet). ``path`` may be
+    the WAL file or the journal directory holding ``journal.jsonl``."""
+    from triton_distributed_tpu.resilience.checkpoint import JOURNAL_NAME
+
+    if os.path.isdir(path):
+        path = os.path.join(path, JOURNAL_NAME)
+    trace = ServeTrace.from_journal(path)
+    fs = trace.final_stats or {}
+    lines = [
+        f"# whatif: journal trace {path}", "",
+        "| field | value |", "|---|---|",
+        f"| arrivals | {len(trace.arrivals)} |",
+        f"| finished | {fs.get('finished', 0)} |",
+        f"| failed | {fs.get('failed', 0)} |",
+        f"| last arrival step | {max((a['at_step'] for a in trace.arrivals), default=0)} |",
+        "",
+    ]
+    by_tenant: dict = {}
+    for a in trace.arrivals:
+        t = a["tenant"] or "-"
+        row = by_tenant.setdefault(
+            t, {"arrivals": 0, "prompt_tok": 0, "out_tok": 0})
+        row["arrivals"] += 1
+        row["prompt_tok"] += len(a["prompt"])
+        out = (trace.outputs or {}).get(a["req_id"])
+        row["out_tok"] += len(out) if out else 0
+    lines += ["## Per-tenant arrivals", "",
+              "| tenant | arrivals | prompt tokens | output tokens |",
+              "|---|---:|---:|---:|"]
+    for t in sorted(by_tenant):
+        r = by_tenant[t]
+        lines.append(f"| {t} | {r['arrivals']} | {r['prompt_tok']} "
+                     f"| {r['out_tok']} |")
+    lines += [
+        "",
+        "Replayable: pass this trace to `ReplayHarness(trace, "
+        "engine=..., engine_kwargs=...)` to run counterfactuals "
+        "(a journal-loaded trace carries no in-memory build spec).",
+        "",
+    ]
+    return "\n".join(lines)
+
+
+# -- entry -------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--demo", action="store_true",
+                    help="record + replay the seeded tiny-fleet demo")
+    ap.add_argument("--seed", type=int, default=0,
+                    help="demo seed (prompts + schedule + clock)")
+    ap.add_argument("--journal", default=None,
+                    help="write-ahead journal file or directory to "
+                         "reconstruct a trace from")
+    ap.add_argument("--out", default=None,
+                    help="write the report here instead of stdout")
+    args = ap.parse_args(argv)
+
+    if args.demo == (args.journal is not None):
+        ap.error("pick exactly one mode: --demo or --journal PATH")
+
+    try:
+        if args.demo:
+            base, report = run_demo(args.seed)
+            if not base.matches_trace or base.lost or base.retraces:
+                sys.stderr.write(
+                    f"whatif: baseline replay diverged from the "
+                    f"recording (bit-identical {base.matches_trace}, "
+                    f"lost {base.lost}, retraces {base.retraces}) — "
+                    "determinism contract broken\n")
+                return 1
+            text = report.to_markdown()
+        else:
+            text = summarize_journal(args.journal)
+    except (OSError, json.JSONDecodeError) as e:
+        sys.stderr.write(f"whatif: {e}\n")
+        return 2
+    except (LookupError, ValueError, RuntimeError) as e:
+        sys.stderr.write(f"whatif: {e}\n")
+        return 1
+
+    if not text.endswith("\n"):
+        text += "\n"
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(text)
+        sys.stdout.write(f"wrote {args.out}\n")
+    else:
+        sys.stdout.write(text)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

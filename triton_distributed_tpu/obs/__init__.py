@@ -44,6 +44,12 @@ runs for weeks):
                    live signal set, cross-layer forensic auto-triage
                    into a ranked suspect list, and a bounded incident
                    ring with cross-replica merge.
+  obs.replay       deterministic replay & what-if observatory: the
+                   always-on ``ServeTrace`` recorder (arrivals, knobs,
+                   calibrated virtual-time cost model), the
+                   ``ReplayHarness`` that re-runs a trace through the
+                   real fleet bit-identically or under counterfactual
+                   configs, and the ranked ``WhatIfReport``.
 
 Perf flight recorder (on top of the three views above):
 
@@ -67,6 +73,7 @@ from triton_distributed_tpu.obs import efficiency  # noqa: F401
 from triton_distributed_tpu.obs import incident  # noqa: F401
 from triton_distributed_tpu.obs import journey  # noqa: F401
 from triton_distributed_tpu.obs import perfdb  # noqa: F401
+from triton_distributed_tpu.obs import replay  # noqa: F401
 from triton_distributed_tpu.obs import roofline  # noqa: F401
 from triton_distributed_tpu.obs import slo  # noqa: F401
 from triton_distributed_tpu.obs import trace  # noqa: F401
@@ -96,6 +103,14 @@ from triton_distributed_tpu.obs.perfdb import (  # noqa: F401
     RunRecord,
     Verdict,
 )
+from triton_distributed_tpu.obs.replay import (  # noqa: F401
+    CostModel,
+    ReplayHarness,
+    ReplayResult,
+    ServeTrace,
+    WhatIfConfig,
+    WhatIfReport,
+)
 from triton_distributed_tpu.obs.roofline import RooflineRecord  # noqa: F401
 from triton_distributed_tpu.obs.metrics import (  # noqa: F401
     Histogram,
@@ -121,13 +136,15 @@ from triton_distributed_tpu.obs.window import (  # noqa: F401
 )
 
 __all__ = [
-    "Blackbox", "CommLedger", "EfficiencyLedger", "FingerprintMismatch",
-    "Histogram", "Incident", "IncidentEngine", "Journey", "JourneyContext",
-    "JourneyRecorder", "LedgerEntry", "Metrics", "Objective", "PerfDB",
+    "Blackbox", "CommLedger", "CostModel", "EfficiencyLedger",
+    "FingerprintMismatch", "Histogram", "Incident", "IncidentEngine",
+    "Journey", "JourneyContext", "JourneyRecorder", "LedgerEntry",
+    "Metrics", "Objective", "PerfDB", "ReplayHarness", "ReplayResult",
     "RequestTrace", "RooflineRecord", "RunRecord", "SLOEngine",
-    "SignalSpec", "SpanRecord", "StepAttribution", "TailSampler", "Tracer",
-    "Verdict", "WindowRing", "WindowStats", "blackbox", "comm_ledger",
+    "ServeTrace", "SignalSpec", "SpanRecord", "StepAttribution",
+    "TailSampler", "Tracer", "Verdict", "WhatIfConfig", "WhatIfReport",
+    "WindowRing", "WindowStats", "blackbox", "comm_ledger",
     "default_serving_slo", "efficiency", "group_profile", "incident",
     "journey", "merge_chrome_traces", "parse_prometheus", "perfdb",
-    "roofline", "slo", "trace", "window",
+    "replay", "roofline", "slo", "trace", "window",
 ]

@@ -203,6 +203,14 @@ class PerfDB:
                 for r in self.runs(suite=suite, fingerprint_=fingerprint_)
                 if metric in r.metrics]
 
+    def trend(self, *, suite: str | None = None,
+              fingerprint_: dict | None = None, tolerance: float = 0.08,
+              metrics: list[str] | None = None) -> list[dict]:
+        """Per-metric drift rows across the recorded history (see module
+        function ``trend``)."""
+        return trend(self.runs(suite=suite, fingerprint_=fingerprint_),
+                     tolerance=tolerance, metrics=metrics)
+
 
 # ---------------------------------------------------------------------------
 # Robust statistics + comparison
@@ -308,6 +316,11 @@ NEUTRAL_CONTEXT = frozenset({
     # directly (zero-lost, bit-identical), not the perfdb delta.
     "crash_step", "crash_seed", "journal_records", "replica_spawns",
     "replica_retirements", "restored_requests",
+    # what-if replay arm context (bench --serve --whatif): workload /
+    # sweep-size echoes and the trace's calibration-sample count — the
+    # bench asserts gate the replay directly (bit-identical, planted
+    # winner), not the perfdb delta.
+    "whatif_requests", "whatif_configs", "whatif_calib_samples",
 })
 
 
@@ -455,3 +468,82 @@ def compare(base_runs: list[RunRecord], head_runs: list[RunRecord], *,
         verdicts.append(Verdict(name, status, direction, base_v, head_v,
                                 delta, len(b), len(h), cls))
     return verdicts
+
+
+# Runs a metric must appear in before trend() will call drift on it —
+# below this the halves are single samples and the "trend" is noise.
+TREND_MIN_RUNS = 4
+
+# Flag severity order for rendering: regressions first.
+_TREND_ORDER = {"drifting-worse": 0, "drifting-better": 1, "flat": 2,
+                "context": 3, "sparse": 4}
+
+
+def trend(runs: list[RunRecord], *, tolerance: float = 0.08,
+          metrics: list[str] | None = None) -> list[dict]:
+    """Per-metric drift across an ordered run history (oldest first —
+    ``PerfDB.runs`` sorts by timestamp): the BENCH_r*.json trajectory
+    turned from write-only JSON into a readable table.
+
+    Each metric's sample sequence is split into older/newer halves and
+    each half anchored with the same robust per-side estimator as
+    ``compare()`` (best-observed quartile under one-sided noise);
+    ``delta_frac`` is signed so POSITIVE always means "drifting worse"
+    regardless of metric direction. Flags, most severe first:
+
+      drifting-worse / drifting-better   |delta| past ``tolerance`` in a
+                                         known direction (overhead
+                                         fractions additionally need the
+                                         absolute delta past the budget
+                                         slack, same as the gate)
+      flat                               within tolerance
+      context                            direction unknown — reported,
+                                         never flagged
+      sparse                             fewer than ``TREND_MIN_RUNS``
+                                         samples — halves would be noise
+
+    Purely informational: callers (``tools/perf_gate.py --trend``) render
+    it; nothing here fails a gate."""
+    col: dict[str, list[float]] = {}
+    for r in runs:
+        for k, v in r.metrics.items():
+            col.setdefault(k, []).append(v)
+    names = metrics or sorted(col)
+    rows: list[dict] = []
+    for name in names:
+        xs = col.get(name, [])
+        direction = metric_direction(name)
+        row = {
+            "metric": name,
+            "direction": direction,
+            "n": len(xs),
+            "first": xs[0] if xs else None,
+            "last": xs[-1] if xs else None,
+        }
+        if len(xs) < TREND_MIN_RUNS:
+            row.update(anchor_old=None, anchor_new=None, delta_frac=None,
+                       flag="sparse")
+        else:
+            half = len(xs) // 2
+            old = robust_anchor(xs[:half], direction)
+            new = robust_anchor(xs[half:], direction)
+            if old == 0:
+                delta = 0.0 if new == 0 else float("inf")
+            else:
+                raw = (new - old) / abs(old)
+                delta = raw if direction <= 0 else -raw
+            if direction == 0:
+                flag = "context"
+            elif _within_abs_slack(name, old, new):
+                flag = "flat"
+            elif delta > tolerance:
+                flag = "drifting-worse"
+            elif delta < -tolerance:
+                flag = "drifting-better"
+            else:
+                flag = "flat"
+            row.update(anchor_old=old, anchor_new=new, delta_frac=delta,
+                       flag=flag)
+        rows.append(row)
+    rows.sort(key=lambda r: (_TREND_ORDER[r["flag"]], r["metric"]))
+    return rows

@@ -1,0 +1,788 @@
+"""Deterministic replay & what-if observatory: journal-driven
+counterfactual serving analysis.
+
+PR 18 made every serving run a crash-consistent, journaled artifact;
+this module exploits that determinism to answer counterfactuals offline:
+"what would goodput have been with 3 replicas / spec k=4 / the
+controller off?" — the offline policy-evaluation instrument ROADMAP
+item 3's elastic-scaling work needs, and the kernel autotuner's
+measure-then-choose discipline lifted to the whole serving fleet.
+
+Three pieces:
+
+  ``ServeTrace``     always-on, bounded-memory recorder riding the fleet
+                     (one ``on_submit`` per request, one ``on_step`` per
+                     fleet step — O(replicas) dict reads, no copies of
+                     engine state). Captures the arrival process (prompt,
+                     tenant, priority, the fleet step index at submit),
+                     the knob configuration in force, per-step work
+                     deltas (prefill tokens / decode rows / speculative
+                     proposals) paired with the efficiency ledger's
+                     accounted step seconds — accumulated into O(1)
+                     normal-equation sums from which a virtual-time cost
+                     model is calibrated — and, at ``finalize``, the
+                     golden outputs. ``from_journal`` rebuilds arrivals +
+                     outputs from a PR 18 write-ahead journal alone
+                     (schema-2 submit frames carry the arrival stamp),
+                     so no live fleet object is required.
+  ``ReplayHarness``  re-runs a recorded trace through the REAL
+                     Fleet/BatchEngine in deterministic virtual time.
+                     The baseline replay anchors each submit on its
+                     recorded fleet-step index — reproducing the live
+                     interleaving exactly — and must be bit-identical to
+                     the recorded run (same output tokens per request,
+                     zero lost requests, zero retraces: replay replicas
+                     adopt a live donor's compiled steps via
+                     ``share_steps_from``, so ``trace_counts`` stays
+                     {1,1}). Counterfactual replays anchor submits on
+                     the baseline's virtual arrival times (the arrival
+                     process is held fixed; only service varies) under
+                     an altered ``WhatIfConfig``: fleet size (resized
+                     through the real ``spawn()``/``retire()``
+                     mechanism), speculative draft cap, prefill budget,
+                     admission pressure, router weights, prefix cache,
+                     controller on/off.
+  ``WhatIfReport``   ranks the counterfactuals on goodput-under-SLO
+                     (SLO bounds derived from the baseline's own
+                     quantiles unless given) with signed deltas vs the
+                     baseline on TTFT/TBT p99 (virtual time), MFU/MBU
+                     (modeled FLOPs/bytes over virtual seconds — fully
+                     deterministic), incident counts, and per-tenant
+                     modeled cost. ``to_markdown()`` is byte-identical
+                     for a fixed trace.
+
+Why outputs stay bit-identical without replaying the chaos schedule:
+greedy decode is a pure function of prompt+output and requeue is
+recompute (PR 11/18), so a trace recorded under replica kills and
+speculative decoding replays to the SAME tokens on a clean fleet — the
+faults only ever displaced work, never changed it. Baseline replay is
+therefore self-validating: a mismatch means the determinism contract
+broke somewhere, which is exactly what the ``bench.py --serve --whatif``
+gate watches.
+
+CLI: ``tools/whatif.py``. Docs: docs/observability.md ("Replay &
+what-if").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from collections import deque
+
+import numpy as np
+
+# Stock virtual-time cost-model coefficients: intercept, per prefill
+# token, per decode row, per drafted position — the fallback when a
+# trace carries too few (or degenerate) calibration samples. Same scale
+# as bench.py's adaptive arm so uncalibrated replays stay comparable.
+STOCK_COEFFS = (1.0, 0.05, 0.02, 0.02)
+# Minimum accounted steps before the normal equations outrank the stock
+# model (fewer rows than this fit noise, not service rates).
+MIN_CALIB_STEPS = 16
+
+_WORK_KEYS = ("prefill_tokens", "decode_rows", "spec_proposed_tokens")
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Virtual seconds one fleet step costs, as an affine function of the
+    work it performed: ``c0 + c_prefill*Δprefill_tokens +
+    c_decode*Δdecode_rows + c_spec*Δspec_proposed_tokens``. Calibrated
+    coefficients are least-squares fits of the efficiency ledger's
+    accounted per-step seconds on the per-step work deltas (clamped
+    non-negative); ``source`` says which model you got."""
+
+    c0: float
+    c_prefill: float
+    c_decode: float
+    c_spec: float
+    source: str = "stock"          # "calibrated" | "stock"
+    n_samples: int = 0
+
+    def step_cost(self, d_prefill: float, d_decode: float,
+                  d_spec: float) -> float:
+        return (self.c0 + self.c_prefill * d_prefill
+                + self.c_decode * d_decode + self.c_spec * d_spec)
+
+    def as_dict(self) -> dict:
+        return {"c0": round(self.c0, 9),
+                "c_prefill": round(self.c_prefill, 9),
+                "c_decode": round(self.c_decode, 9),
+                "c_spec": round(self.c_spec, 9),
+                "source": self.source, "n_samples": self.n_samples}
+
+
+def _fleet_counters(fleet) -> dict:
+    """Monotone fleet-wide work totals (DEAD replicas stay in the list,
+    so sums never step backwards across retire/spawn)."""
+    tot = dict.fromkeys(_WORK_KEYS, 0.0)
+    tot["interval_s"] = 0.0
+    for rep in fleet.replicas:
+        c = rep.engine.metrics.counters
+        for k in _WORK_KEYS:
+            tot[k] += c.get(k, 0.0)
+        led = rep.engine.efficiency
+        if led is not None:
+            tot["interval_s"] += led._tot_interval
+    return tot
+
+
+class ServeTrace:
+    """Always-on serving recorder (one per fleet; see ``Fleet.build``).
+
+    Bounded memory: at most ``max_arrivals`` arrival records are kept
+    (extras counted in ``dropped_arrivals`` — a trace with drops refuses
+    to replay rather than silently replaying a prefix), a
+    ``keep_steps``-deep ring of recent per-step work rows for forensics,
+    and O(1) normal-equation accumulators for the cost model no matter
+    how long the fleet runs."""
+
+    def __init__(self, *, max_arrivals: int = 4096, keep_steps: int = 256):
+        self.max_arrivals = int(max_arrivals)
+        self.arrivals: list[dict] = []
+        self.dropped_arrivals = 0
+        self.n_steps = 0
+        self.recent_steps: deque[dict] = deque(maxlen=keep_steps)
+        # Normal equations for [1, d_prefill, d_decode, d_spec] -> dt.
+        self._xtx = np.zeros((4, 4), dtype=np.float64)
+        self._xty = np.zeros(4, dtype=np.float64)
+        self._n_samples = 0
+        self._last: dict | None = None
+        self.config: dict = {}
+        self.build_spec = None      # (model Engine, BatchEngine kwargs)
+        self.outputs: dict | None = None
+        self.failed: dict | None = None
+        self.final_stats: dict | None = None
+
+    # -- recording hooks (called by Fleet) ----------------------------------
+
+    def on_submit(self, req, at_step: int) -> None:
+        if len(self.arrivals) >= self.max_arrivals:
+            self.dropped_arrivals += 1
+            return
+        self.arrivals.append({
+            "seq": len(self.arrivals),
+            "at_step": int(at_step),
+            "req_id": req.req_id,
+            "prompt": [int(t) for t in req.prompt],
+            "max_new_tokens": int(req.max_new_tokens),
+            "priority": int(req.priority),
+            "tenant": req.tenant,
+            "arrival_t": req.submit_t,
+        })
+
+    def on_step(self, fleet) -> None:
+        if not self.config:
+            self._capture_config(fleet)
+        # A controller can attach after the first step — keep the flag
+        # live so the baseline replay reproduces the control plane.
+        self.config["controller"] = fleet._controller is not None
+        cur = _fleet_counters(fleet)
+        if self._last is not None:
+            d = {k: cur[k] - self._last[k] for k in _WORK_KEYS}
+            dt = cur["interval_s"] - self._last["interval_s"]
+            if dt > 0.0:
+                x = np.array([1.0, d["prefill_tokens"], d["decode_rows"],
+                              d["spec_proposed_tokens"]], dtype=np.float64)
+                self._xtx += np.outer(x, x)
+                self._xty += dt * x
+                self._n_samples += 1
+            self.recent_steps.append(
+                {**{k: d[k] for k in _WORK_KEYS}, "dt": dt})
+        self._last = cur
+        self.n_steps += 1
+
+    def _capture_config(self, fleet) -> None:
+        r = fleet.router
+        eng = fleet.replicas[0].engine
+        spec = getattr(eng, "spec", None)
+        cache = getattr(eng, "prefix_cache", None)
+        self.config = {
+            "n_replicas": len(fleet.replicas),
+            "router": {"w_cache": r.w_cache, "w_headroom": r.w_headroom,
+                       "w_queue": r.w_queue,
+                       "slo_penalty": list(r.slo_penalty)},
+            "admission_pressure": float(fleet.admission_pressure),
+            "controller": fleet._controller is not None,
+            "prefill_budget": int(eng.prefill_budget),
+            "speculative": spec is not None,
+            "spec_k_cap": (int(getattr(spec.controller, "k_cap", 0))
+                           if spec is not None else None),
+            "prefix_cache": bool(cache is not None and cache.enabled),
+        }
+        self.build_spec = fleet._build_spec
+
+    def finalize(self, fleet) -> "ServeTrace":
+        """Snapshot the golden outcome (call once the live run is idle):
+        per-request output tokens, terminal failures, and summary stats.
+        Returns self for chaining."""
+        self.outputs = {rid: [int(t) for t in req.output]
+                        for rid, req in fleet.finished.items()}
+        self.failed = {rid: req.error for rid, req in fleet.failed.items()}
+        self.final_stats = {
+            "n_steps": int(fleet.n_steps),
+            "submitted": len(fleet._submitted),
+            "finished": len(self.outputs),
+            "failed": len(self.failed),
+        }
+        return self
+
+    # -- cost model ---------------------------------------------------------
+
+    def cost_model(self) -> CostModel:
+        """Least-squares calibration of the virtual-time coefficients
+        from the accumulated (work delta -> ledger seconds) samples;
+        falls back to ``STOCK_COEFFS`` when the trace is too short or
+        the fit degenerates (non-finite / non-positive intercept)."""
+        if self._n_samples >= MIN_CALIB_STEPS:
+            try:
+                coef, *_ = np.linalg.lstsq(self._xtx, self._xty,
+                                           rcond=None)
+            except np.linalg.LinAlgError:
+                coef = None
+            if coef is not None and np.all(np.isfinite(coef)) \
+                    and coef[0] > 0.0:
+                return CostModel(
+                    c0=float(coef[0]),
+                    c_prefill=float(max(coef[1], 0.0)),
+                    c_decode=float(max(coef[2], 0.0)),
+                    c_spec=float(max(coef[3], 0.0)),
+                    source="calibrated", n_samples=self._n_samples)
+        c0, cp, cd, cv = STOCK_COEFFS
+        return CostModel(c0=c0, c_prefill=cp, c_decode=cd, c_spec=cv,
+                         source="stock", n_samples=self._n_samples)
+
+    # -- (de)serialization --------------------------------------------------
+
+    def dump(self) -> dict:
+        """JSON-able trace (everything but the in-memory build spec —
+        an offline consumer supplies its own model engine)."""
+        return {
+            "schema": 1,
+            "arrivals": list(self.arrivals),
+            "dropped_arrivals": self.dropped_arrivals,
+            "n_steps": self.n_steps,
+            "config": {k: v for k, v in self.config.items()},
+            "outputs": self.outputs,
+            "failed": self.failed,
+            "final_stats": self.final_stats,
+            "calib": {"xtx": self._xtx.tolist(),
+                      "xty": self._xty.tolist(),
+                      "n_samples": self._n_samples},
+            "cost_model": self.cost_model().as_dict(),
+        }
+
+    def dump_json(self, path: str) -> str:
+        with open(path, "w", encoding="utf-8") as f:
+            json.dump(self.dump(), f, indent=1, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, data: dict) -> "ServeTrace":
+        tr = cls()
+        tr.arrivals = list(data.get("arrivals", ()))
+        tr.dropped_arrivals = int(data.get("dropped_arrivals", 0))
+        tr.n_steps = int(data.get("n_steps", 0))
+        tr.config = dict(data.get("config") or {})
+        tr.outputs = data.get("outputs")
+        tr.failed = data.get("failed")
+        tr.final_stats = data.get("final_stats")
+        calib = data.get("calib") or {}
+        if calib:
+            tr._xtx = np.asarray(calib["xtx"], dtype=np.float64)
+            tr._xty = np.asarray(calib["xty"], dtype=np.float64)
+            tr._n_samples = int(calib.get("n_samples", 0))
+        return tr
+
+    @classmethod
+    def from_journal(cls, path: str) -> "ServeTrace":
+        """Reconstruct a trace from a PR 18 write-ahead journal alone:
+        schema-2 submit frames carry the arrival stamp (``arrival_step``,
+        ``arrival_t``, ``tenant``), emit/finish frames rebuild the golden
+        outputs. Schema-1 journals load too (arrivals collapse to step 0
+        — order is still exact via ``seq``); with no per-step ledger data
+        in a journal the cost model stays stock."""
+        from triton_distributed_tpu.resilience import checkpoint as _ckpt
+
+        jr = _ckpt.read_journal(path)
+        tr = cls()
+        for rec in jr.records:
+            if rec["kind"] != "submit":
+                continue
+            tr.arrivals.append({
+                "seq": len(tr.arrivals),
+                "at_step": int(rec.get("arrival_step") or 0),
+                "req_id": rec["req_id"],
+                "prompt": [int(t) for t in rec["prompt"]],
+                "max_new_tokens": int(rec["max_new_tokens"]),
+                "priority": int(rec.get("priority", 0)),
+                "tenant": rec.get("tenant"),
+                "arrival_t": rec.get("arrival_t"),
+            })
+        reqs = _ckpt.replay_requests(jr.records)
+        tr.outputs = {rid: list(w["output"]) for rid, w in reqs.items()
+                      if w["status"] == "ok"}
+        tr.failed = {rid: w.get("error") for rid, w in reqs.items()
+                     if w["status"] == "failed"}
+        if tr.arrivals:
+            tr.n_steps = max(a["at_step"] for a in tr.arrivals) + 1
+        tr.final_stats = {"n_steps": tr.n_steps,
+                          "submitted": len(tr.arrivals),
+                          "finished": len(tr.outputs),
+                          "failed": len(tr.failed)}
+        return tr
+
+
+@dataclasses.dataclass
+class WhatIfConfig:
+    """One counterfactual: every field left ``None`` keeps the recorded
+    value, so a config names exactly the knobs it moves. ``n_replicas``
+    is reached through the real elastic mechanism (build at the recorded
+    size, then ``spawn()``/``retire()`` to the target)."""
+
+    name: str
+    n_replicas: int | None = None
+    prefill_budget: int | None = None
+    admission_pressure: float | None = None
+    spec_k_cap: int | None = None
+    router: dict | None = None          # w_cache/w_headroom/w_queue/
+                                        # slo_penalty overrides
+    prefix_cache: bool | None = None
+    controller: bool | None = None
+    engine_kwargs: dict | None = None   # raw BatchEngine kwarg overrides
+
+    def as_dict(self) -> dict:
+        out = {"name": self.name}
+        for f in dataclasses.fields(self):
+            if f.name in ("name", "engine_kwargs"):
+                continue
+            v = getattr(self, f.name)
+            if v is not None:
+                out[f.name] = v
+        return out
+
+
+@dataclasses.dataclass
+class ReplayResult:
+    """Outcome of one replay: golden comparison + virtual-time stats."""
+
+    name: str
+    outputs: dict                  # req_id -> [token ids] (finished ok)
+    failed: dict                   # req_id -> error
+    requests: dict                 # req_id -> {submit_vt, first_vt,
+                                   #   finish_vt, tokens, tenant, status}
+    n_steps: int
+    vt_total: float
+    arrival_vt: dict               # seq -> virtual submit time
+    mfu: float
+    mbu: float
+    incidents: int
+    tenant_cost: list              # merged modeled per-tenant cost rows
+    retraces: int
+    matches_trace: bool            # outputs bit-identical to the trace
+    lost: int                      # recorded arrivals that never settled
+
+    def ttfts(self) -> list[float]:
+        return sorted(r["first_vt"] - r["submit_vt"]
+                      for r in self.requests.values()
+                      if r["first_vt"] is not None)
+
+    def tbts(self) -> list[float]:
+        out = []
+        for r in self.requests.values():
+            if r["first_vt"] is None or r["finish_vt"] is None:
+                continue
+            out.append((r["finish_vt"] - r["first_vt"])
+                       / max(1, r["tokens"] - 1))
+        return sorted(out)
+
+
+def _quantile(vals: list, q: float) -> float:
+    """Deterministic nearest-rank quantile (no interpolation drift)."""
+    if not vals:
+        return 0.0
+    vals = sorted(vals)
+    idx = min(len(vals) - 1, max(0, int(np.ceil(q * len(vals))) - 1))
+    return float(vals[idx])
+
+
+class ReplayHarness:
+    """Re-run a recorded ``ServeTrace`` through the real fleet in
+    deterministic virtual time.
+
+    ``engine``/``engine_kwargs`` default from the trace's in-memory
+    build spec (a journal-loaded trace must supply them). ``donor`` is a
+    live ``BatchEngine`` whose compiled steps every replay replica
+    adopts (``share_steps_from``) so a replay never retraces —
+    ``trace_counts`` stays {1,1}."""
+
+    def __init__(self, trace: ServeTrace, engine=None, engine_kwargs=None,
+                 *, donor=None, fleet_kwargs=None, max_steps=None):
+        if trace.dropped_arrivals:
+            raise ValueError(
+                f"trace dropped {trace.dropped_arrivals} arrival(s) past "
+                "its memory bound — refusing to replay a prefix as if it "
+                "were the full run (raise ServeTrace(max_arrivals=...))")
+        if engine is None:
+            if trace.build_spec is None:
+                raise ValueError(
+                    "trace has no in-memory build spec (journal-loaded?) "
+                    "— pass engine= and engine_kwargs= explicitly")
+            engine, spec_kwargs = trace.build_spec
+            engine_kwargs = dict(spec_kwargs) if engine_kwargs is None \
+                else dict(engine_kwargs)
+        self.trace = trace
+        self.engine = engine
+        self.engine_kwargs = dict(engine_kwargs or {})
+        self.donor = donor
+        self.fleet_kwargs = dict(fleet_kwargs or {})
+        self.cost = trace.cost_model()
+        self.max_steps = (max_steps if max_steps is not None
+                          else max(4 * trace.n_steps, 512) + 64
+                          * max(1, len(trace.arrivals)))
+        self._baseline: ReplayResult | None = None
+
+    # -- fleet construction -------------------------------------------------
+
+    def _build_fleet(self, cfg: WhatIfConfig):
+        from triton_distributed_tpu.serving.fleet import Fleet
+        from triton_distributed_tpu.serving.router import Router
+
+        rec = self.trace.config
+        kw = dict(self.engine_kwargs)
+        if cfg.prefix_cache is not None:
+            kw["prefix_cache"] = bool(cfg.prefix_cache)
+        if cfg.engine_kwargs:
+            kw.update(cfg.engine_kwargs)
+        rkw = dict(rec.get("router") or {})
+        if cfg.router:
+            rkw.update(cfg.router)
+        router = None
+        if rkw:
+            router = Router(
+                w_cache=float(rkw.get("w_cache", 2.0)),
+                w_headroom=float(rkw.get("w_headroom", 0.5)),
+                w_queue=float(rkw.get("w_queue", 1.0)),
+                slo_penalty=tuple(rkw.get("slo_penalty",
+                                          (0.0, 0.75, 10.0))))
+        ap = (cfg.admission_pressure
+              if cfg.admission_pressure is not None
+              else rec.get("admission_pressure", 0.0))
+        n_rec = int(rec.get("n_replicas", 1))
+        fleet = Fleet.build(self.engine, n_replicas=n_rec, router=router,
+                            admission_pressure=float(ap),
+                            serve_trace=False, **self.fleet_kwargs, **kw)
+        if self.donor is not None:
+            for rep in fleet.replicas:
+                rep.engine.share_steps_from(self.donor)
+        # Elastic resize through the REAL mechanism: spawn() adopts a
+        # live sharer's compiled steps, retire() drains (nothing is
+        # queued yet, so the drain is empty) — the same moves a scaling
+        # policy would issue online.
+        target = int(cfg.n_replicas) if cfg.n_replicas is not None \
+            else n_rec
+        if target < 1:
+            raise ValueError("n_replicas must be >= 1")
+        for _ in range(target - n_rec):
+            fleet.spawn()
+        for idx in range(n_rec - 1, target - 1, -1):
+            fleet.retire(idx)
+        pb = (cfg.prefill_budget if cfg.prefill_budget is not None
+              else rec.get("prefill_budget"))
+        kcap = (cfg.spec_k_cap if cfg.spec_k_cap is not None
+                else rec.get("spec_k_cap"))
+        for rep in fleet.replicas:
+            eng = rep.engine
+            if pb is not None:
+                eng.prefill_budget = int(pb)
+            if kcap is not None and getattr(eng, "spec", None) is not None:
+                eng.spec.controller.k_cap = int(kcap)
+        ctl = (cfg.controller if cfg.controller is not None
+               else rec.get("controller", False))
+        if ctl:
+            fleet.attach_controller()
+        return fleet
+
+    # -- replay loops -------------------------------------------------------
+
+    def baseline(self) -> ReplayResult:
+        """Replay anchored on the recorded fleet-step indices (exact live
+        interleaving); memoized — counterfactuals reuse its virtual
+        arrival times."""
+        if self._baseline is None:
+            self._baseline = self._run(WhatIfConfig(name="baseline"),
+                                       anchor="step")
+        return self._baseline
+
+    def replay(self, cfg: WhatIfConfig) -> ReplayResult:
+        """One counterfactual replay: submits fire when the config's own
+        virtual clock passes each request's baseline arrival time."""
+        base = self.baseline()
+        return self._run(cfg, anchor="vt", arrival_vt=base.arrival_vt)
+
+    def _run(self, cfg: WhatIfConfig, *, anchor: str,
+             arrival_vt: dict | None = None) -> ReplayResult:
+        fleet = self._build_fleet(cfg)
+        arrivals = sorted(self.trace.arrivals, key=lambda a: a["seq"])
+        vt = 0.0
+        vt_arr: dict = {}
+        submit_vt: dict = {}
+        first_vt: dict = {}
+        finish_vt: dict = {}
+        last = _fleet_counters(fleet)
+        i = 0
+        steps = 0
+        while True:
+            while i < len(arrivals):
+                a = arrivals[i]
+                if anchor == "step":
+                    due = a["at_step"] <= fleet.n_steps
+                else:
+                    due = arrival_vt.get(a["seq"], 0.0) <= vt
+                if not due:
+                    break
+                fleet.submit(a["prompt"], a["max_new_tokens"],
+                             priority=a["priority"], req_id=a["req_id"],
+                             tenant=a["tenant"])
+                vt_arr[a["seq"]] = vt
+                submit_vt[a["req_id"]] = vt
+                i += 1
+            if i >= len(arrivals) and not fleet._pending and all(
+                    rep.empty or rep.state == "DEAD"
+                    for rep in fleet.replicas):
+                break
+            if steps >= self.max_steps:
+                raise RuntimeError(
+                    f"replay '{cfg.name}' exceeded {self.max_steps} steps "
+                    "without settling — config cannot serve this trace")
+            fleet.step()
+            steps += 1
+            cur = _fleet_counters(fleet)
+            vt += self.cost.step_cost(
+                cur["prefill_tokens"] - last["prefill_tokens"],
+                cur["decode_rows"] - last["decode_rows"],
+                cur["spec_proposed_tokens"] - last["spec_proposed_tokens"])
+            last = cur
+            for rid, req in fleet._submitted.items():
+                if rid not in first_vt and len(req.output) > 0:
+                    first_vt[rid] = vt
+                if rid not in finish_vt and req.status in ("ok", "failed"):
+                    finish_vt[rid] = vt
+        return self._result(cfg, fleet, vt, vt_arr, submit_vt, first_vt,
+                            finish_vt)
+
+    def _result(self, cfg, fleet, vt, vt_arr, submit_vt, first_vt,
+                finish_vt) -> ReplayResult:
+        from triton_distributed_tpu.obs.efficiency import EfficiencyLedger
+
+        outputs = {rid: [int(t) for t in req.output]
+                   for rid, req in fleet.finished.items()}
+        failed = {rid: req.error for rid, req in fleet.failed.items()}
+        requests = {}
+        for rid, vt0 in submit_vt.items():
+            req = fleet._submitted.get(rid)
+            requests[rid] = {
+                "submit_vt": vt0,
+                "first_vt": first_vt.get(rid),
+                "finish_vt": finish_vt.get(rid),
+                "tokens": len(req.output) if req is not None else 0,
+                "tenant": req.tenant if req is not None else None,
+                "status": req.status if req is not None else "lost",
+            }
+        ledgers = [rep.engine.efficiency for rep in fleet.replicas
+                   if rep.engine.efficiency is not None]
+        flops = sum(led._tot_flops for led in ledgers)
+        bytes_ = sum(led._tot_bytes for led in ledgers)
+        peak = ledgers[0].peak_flops if ledgers else 0.0
+        pipe = ledgers[0].hbm_bw if ledgers else 0.0
+        # MFU/MBU over VIRTUAL seconds: modeled FLOPs and bytes are
+        # deterministic and so is vt, so these ratios are byte-stable —
+        # unlike the live ledger's wall-interval ratios.
+        mfu = flops / (peak * vt) if peak > 0 and vt > 0 else 0.0
+        mbu = bytes_ / (pipe * vt) if pipe > 0 and vt > 0 else 0.0
+        incidents = sum(rep.engine.incidents.n_opened
+                        for rep in fleet.replicas
+                        if rep.engine.incidents is not None)
+        if fleet.incidents is not None:
+            incidents += fleet.incidents.n_opened
+        tenant_cost = EfficiencyLedger.merge_tenant_tables(
+            [led.tenant_table() for led in ledgers])
+        uniq = {id(rep.engine.trace_counts): rep.engine.trace_counts
+                for rep in fleet.replicas}
+        retraces = sum(tc["decode"] + tc["prefill"] - 2
+                       for tc in uniq.values())
+        golden = self.trace.outputs or {}
+        matches = (set(outputs) >= set(golden)
+                   and all(outputs.get(rid) == toks
+                           for rid, toks in golden.items()))
+        settled = set(outputs) | set(failed)
+        lost = sum(1 for a in self.trace.arrivals
+                   if a["req_id"] not in settled)
+        return ReplayResult(
+            name=cfg.name, outputs=outputs, failed=failed,
+            requests=requests, n_steps=int(fleet.n_steps),
+            vt_total=vt, arrival_vt=vt_arr, mfu=mfu, mbu=mbu,
+            incidents=incidents, tenant_cost=tenant_cost,
+            retraces=retraces, matches_trace=matches, lost=lost)
+
+    # -- sweep --------------------------------------------------------------
+
+    def sweep(self, configs, *, ttft_slo=None,
+              tbt_slo=None) -> "WhatIfReport":
+        """Baseline + every config -> ranked ``WhatIfReport``."""
+        base = self.baseline()
+        results = [self.replay(c) for c in configs]
+        return WhatIfReport.build(base, results, ttft_slo=ttft_slo,
+                                  tbt_slo=tbt_slo,
+                                  cost_model=self.cost,
+                                  configs=list(configs))
+
+
+class WhatIfReport:
+    """Ranked counterfactual comparison. Rows are sorted by
+    goodput-under-SLO (desc, name-tiebroken) with signed deltas vs the
+    baseline; SLO bounds default to the baseline's own p90 quantiles
+    with 25% headroom, so "strictly better than the run we had" is the
+    definition of winning."""
+
+    def __init__(self, baseline_row: dict, rows: list, slo: dict,
+                 cost_model: CostModel | None = None):
+        self.baseline = baseline_row
+        self.rows = rows
+        self.slo = slo
+        self.cost_model = cost_model
+
+    @staticmethod
+    def _row(res: ReplayResult, slo: dict, cfg: dict | None) -> dict:
+        ttfts, tbts = res.ttfts(), res.tbts()
+        met = 0
+        for r in res.requests.values():
+            if r["status"] != "ok" or r["first_vt"] is None \
+                    or r["finish_vt"] is None:
+                continue
+            ttft = r["first_vt"] - r["submit_vt"]
+            tbt = ((r["finish_vt"] - r["first_vt"])
+                   / max(1, r["tokens"] - 1))
+            if ttft <= slo["ttft"] and tbt <= slo["tbt"]:
+                met += r["tokens"]
+        total = sum(r["tokens"] for r in res.requests.values())
+        return {
+            "name": res.name,
+            "config": cfg or {},
+            "goodput": met / max(res.vt_total, 1e-9),
+            "met_tokens": met,
+            "total_tokens": total,
+            "ttft_p99": _quantile(ttfts, 0.99),
+            "tbt_p99": _quantile(tbts, 0.99),
+            "mfu": res.mfu,
+            "mbu": res.mbu,
+            "incidents": res.incidents,
+            "vt_total": res.vt_total,
+            "n_steps": res.n_steps,
+            "lost": res.lost,
+            "failed": len(res.failed),
+            "retraces": res.retraces,
+            "matches_trace": res.matches_trace,
+            "tenant_cost": [
+                {"tenant": t["tenant"], "tokens": t["tokens"],
+                 "flops": t["flops"], "hbm_bytes": t["hbm_bytes"]}
+                for t in res.tenant_cost],
+        }
+
+    @classmethod
+    def build(cls, baseline: ReplayResult, results, *, ttft_slo=None,
+              tbt_slo=None, cost_model=None,
+              configs=None) -> "WhatIfReport":
+        slo = {
+            "ttft": (float(ttft_slo) if ttft_slo is not None
+                     else _quantile(baseline.ttfts(), 0.90) * 1.25),
+            "tbt": (float(tbt_slo) if tbt_slo is not None
+                    else _quantile(baseline.tbts(), 0.90) * 1.25),
+        }
+        cfg_by_name = {c.name: c.as_dict() for c in (configs or ())}
+        base_row = cls._row(baseline, slo, {"name": "baseline"})
+        rows = [cls._row(r, slo, cfg_by_name.get(r.name))
+                for r in results]
+        for row in rows:
+            for key in ("goodput", "ttft_p99", "tbt_p99", "mfu", "mbu",
+                        "incidents", "vt_total"):
+                row[f"d_{key}"] = row[key] - base_row[key]
+        rows.sort(key=lambda r: (-r["goodput"], r["name"]))
+        for rank, row in enumerate(rows, start=1):
+            row["rank"] = rank
+        return cls(base_row, rows, slo, cost_model)
+
+    def winner(self) -> dict | None:
+        return self.rows[0] if self.rows else None
+
+    def as_dict(self) -> dict:
+        return {
+            "slo": {k: round(v, 9) for k, v in self.slo.items()},
+            "cost_model": (self.cost_model.as_dict()
+                           if self.cost_model is not None else None),
+            "baseline": self.baseline,
+            "rows": self.rows,
+        }
+
+    def to_markdown(self) -> str:
+        """Deterministic markdown report (byte-identical per trace)."""
+        def f(x, nd=4):
+            return f"{x:.{nd}f}"
+
+        def sf(x, nd=4):
+            return f"{x:+.{nd}f}"
+
+        lines = ["# What-if report", ""]
+        if self.cost_model is not None:
+            cm = self.cost_model
+            lines.append(
+                f"Cost model ({cm.source}, {cm.n_samples} samples): "
+                f"vt/step = {f(cm.c0, 6)} + {f(cm.c_prefill, 6)}"
+                f"*prefill_tok + {f(cm.c_decode, 6)}*decode_row + "
+                f"{f(cm.c_spec, 6)}*spec_tok")
+        lines.append(f"SLO bounds (virtual s): ttft <= "
+                     f"{f(self.slo['ttft'], 6)}, tbt <= "
+                     f"{f(self.slo['tbt'], 6)}")
+        b = self.baseline
+        lines += [
+            "",
+            f"Baseline: goodput {f(b['goodput'])} "
+            f"({b['met_tokens']}/{b['total_tokens']} tokens under SLO), "
+            f"ttft_p99 {f(b['ttft_p99'])}, tbt_p99 {f(b['tbt_p99'])}, "
+            f"mfu {f(b['mfu'])}, mbu {f(b['mbu'])}, "
+            f"incidents {b['incidents']}, vt {f(b['vt_total'], 2)}, "
+            f"steps {b['n_steps']}, lost {b['lost']}, "
+            f"retraces {b['retraces']}, "
+            f"bit-identical {b['matches_trace']}",
+            "",
+            "| rank | config | goodput | Δgoodput | ttft_p99 | tbt_p99 "
+            "| mfu | mbu | incidents | vt | lost |",
+            "|---|---|---|---|---|---|---|---|---|---|---|",
+        ]
+        for row in self.rows:
+            lines.append(
+                f"| {row['rank']} | {row['name']} | {f(row['goodput'])} "
+                f"| {sf(row['d_goodput'])} | {f(row['ttft_p99'])} "
+                f"| {f(row['tbt_p99'])} | {f(row['mfu'])} "
+                f"| {f(row['mbu'])} | {row['incidents']} "
+                f"| {f(row['vt_total'], 2)} | {row['lost']} |")
+        lines.append("")
+        tenants = {}
+        for row in [self.baseline, *self.rows]:
+            for t in row.get("tenant_cost", ()):
+                tenants.setdefault(t["tenant"], {})[row["name"]] = t
+        if tenants:
+            lines.append("## Per-tenant modeled cost (tokens / GFLOPs)")
+            lines.append("")
+            for tenant in sorted(tenants):
+                parts = []
+                for row in [self.baseline, *self.rows]:
+                    t = tenants[tenant].get(row["name"])
+                    if t is None:
+                        continue
+                    parts.append(f"{row['name']}: {t['tokens']} tok, "
+                                 f"{t['flops'] / 1e9:.3f} GF")
+                lines.append(f"- **{tenant}** — " + "; ".join(parts))
+            lines.append("")
+        return "\n".join(lines)

@@ -50,7 +50,14 @@ import zlib
 
 from triton_distributed_tpu.resilience import faults as _faults
 
-SCHEMA_VERSION = 1
+# Schema 2 (PR 19): submit frames additionally persist the fleet
+# arrival stamp (``arrival_t`` wall clock, ``arrival_step`` fleet step
+# index) next to the ``tenant`` tag, so post-hoc tools can bill tenants
+# and reconstruct arrival processes without a live fleet. Reads stay
+# back-compatible: every new field is ``rec.get(...)``-optional and
+# schema-1 checkpoints/journals load unchanged.
+SCHEMA_VERSION = 2
+COMPAT_SCHEMAS = frozenset({1, SCHEMA_VERSION})
 MANIFEST_NAME = "manifest.json"
 STATE_NAME = "state.json"
 JOURNAL_NAME = "journal.jsonl"
@@ -306,6 +313,11 @@ def replay_requests(records, base: dict | None = None) -> dict:
                 "priority": rec.get("priority", 0),
                 "arrival_seq": rec.get("arrival_seq"),
                 "tenant": rec.get("tenant"),
+                # Schema-2 arrival stamps (absent from v1 journals);
+                # ``Request.from_wire`` ignores the extras but post-hoc
+                # tools (whatif, explain_request --journal) read them.
+                "arrival_t": rec.get("arrival_t"),
+                "arrival_step": rec.get("arrival_step"),
                 "output": [], "n_preemptions": 0,
                 "status": "pending", "error": None, "requeues": [],
             }
@@ -397,10 +409,10 @@ def load_checkpoint(ckpt_dir: str, *, check_fingerprint: bool = True):
             manifest = json.load(f)
     except (OSError, json.JSONDecodeError) as e:
         raise CheckpointCorruption(f"unreadable manifest: {e}") from e
-    if manifest.get("schema") != SCHEMA_VERSION:
+    if manifest.get("schema") not in COMPAT_SCHEMAS:
         raise CheckpointCorruption(
-            f"checkpoint schema {manifest.get('schema')!r} != "
-            f"{SCHEMA_VERSION}")
+            f"checkpoint schema {manifest.get('schema')!r} not in "
+            f"{sorted(COMPAT_SCHEMAS)}")
     try:
         with open(state_path, "rb") as f:
             payload = f.read()

@@ -159,7 +159,8 @@ class Fleet:
                  breach_quarantine_evals: int = 3,
                  recovery_steps: int = 8,
                  admission_pressure: float = 0.0,
-                 revive_cooldown_steps: int = 8):
+                 revive_cooldown_steps: int = 8,
+                 serve_trace: bool = True):
         engines = list(engines)
         if not engines:
             raise ValueError("a fleet needs at least one replica")
@@ -228,6 +229,17 @@ class Fleet:
                 if self._controller is not None else ())
         else:
             self.incidents = None
+        # Always-on serving recorder (obs/replay.py): bounded-memory
+        # arrival + per-step work capture feeding the deterministic
+        # replay/what-if harness. One on_submit per request and one
+        # O(replicas) counter read per step — cheap enough to leave on
+        # (bench --serve --whatif gates the overhead); replay fleets
+        # themselves run with serve_trace=False.
+        if serve_trace:
+            from triton_distributed_tpu.obs.replay import ServeTrace
+            self.serve_trace = ServeTrace()
+        else:
+            self.serve_trace = None
 
     # -- construction -------------------------------------------------------
 
@@ -236,7 +248,8 @@ class Fleet:
               requeue=None, fail_threshold: int = 3,
               breach_quarantine_evals: int = 3, recovery_steps: int = 8,
               admission_pressure: float = 0.0,
-              revive_cooldown_steps: int = 8, **batch_engine_kwargs
+              revive_cooldown_steps: int = 8, serve_trace: bool = True,
+              **batch_engine_kwargs
               ) -> "Fleet":
         """N identically-configured replicas over ONE model ``Engine``
         (shared params — requeue-by-recompute stays bit-exact; each
@@ -253,7 +266,8 @@ class Fleet:
                     breach_quarantine_evals=breach_quarantine_evals,
                     recovery_steps=recovery_steps,
                     admission_pressure=admission_pressure,
-                    revive_cooldown_steps=revive_cooldown_steps)
+                    revive_cooldown_steps=revive_cooldown_steps,
+                    serve_trace=serve_trace)
         # Recorded so ``spawn()`` can build an identical replica later.
         fleet._build_spec = (engine, dict(batch_engine_kwargs))
         return fleet
@@ -299,12 +313,19 @@ class Fleet:
             # propagate to the caller — an unjournaled accepted request
             # would be silently lost by a crash, which is the one thing
             # this subsystem exists to prevent.
+            # Schema 2: the arrival stamp (wall clock + fleet step index)
+            # rides the submit frame so post-hoc tools can reconstruct
+            # the arrival process and bill tenants without a live fleet.
             self.journal.append("submit", req_id=req_id, prompt=prompt,
                                 max_new_tokens=int(max_new_tokens),
                                 priority=int(priority),
-                                arrival_seq=req.arrival_seq, tenant=tenant)
+                                arrival_seq=req.arrival_seq, tenant=tenant,
+                                arrival_t=req.submit_t,
+                                arrival_step=int(self.n_steps))
         self._submitted[req_id] = req
         self._pending.append(req)
+        if self.serve_trace is not None:
+            self.serve_trace.on_submit(req, self.n_steps)
         _trace.async_begin("request", req_id, prompt_len=len(prompt),
                            max_new_tokens=max_new_tokens)
         if self.journey is not None:
@@ -916,6 +937,8 @@ class Fleet:
         moved = self._drain()
         routed = self._route_pending()
         busy = self._step_replicas()
+        if self.serve_trace is not None:
+            self.serve_trace.on_step(self)
         return moved or routed or busy
 
     def run(self, max_steps: int | None = None) -> dict:
