@@ -185,6 +185,30 @@ EOF
   done
 done
 
+# Quantized KV cache (ISSUE 20): int8 wire dtype must cut the modeled AND
+# ledger-confirmed KV bytes to <=0.55x the bf16 fused baseline on every
+# step shape, and the equal-HBM-budget serving comparison must show the
+# quantized engine's windowed MBU strictly above the bf16 run's.
+for i in 1 2; do
+  echo "perf_gate_smoke: paged_kvq run $i/2" >&2
+  python bench.py --paged-attn --kv-dtype int8 \
+    --perfdb "$DB" > "$WORKDIR/paged_kvq_out.$i.json"
+  python - "$WORKDIR/paged_kvq_out.$i.json" <<'EOF'
+import json, sys
+line = open(sys.argv[1]).read().strip().splitlines()[-1]
+obj = json.loads(line)
+assert "backend" in obj and "metric" in obj, sorted(obj)
+assert obj.get("error") is None, obj.get("error")
+assert obj["value"] is not None and obj["value"] <= 0.55, obj["value"]
+ex = obj.get("extras", {})
+for row in ("decode", "prefill", "mixed"):
+    assert ex.get(f"paged_kvq_{row}_kv_bytes_ratio", 1.0) <= 0.55, (row, ex)
+    assert ex.get(f"paged_kvq_{row}_ledger_bytes_match") is True, (row, ex)
+assert ex.get("kvq_mbu_uplift", 0.0) > 1.0, ex.get("kvq_mbu_uplift")
+assert ex.get("kvq_prefix_hits", 0) > 0, ex.get("kvq_prefix_hits")
+EOF
+done
+
 for i in 1 2; do
   echo "perf_gate_smoke: probe_overhead run $i/2" >&2
   python bench.py --probe-overhead --perfdb "$DB" \
@@ -496,6 +520,10 @@ python tools/perf_gate.py --db "$DB" --suite bench \
 echo "perf_gate_smoke: gating paged_attn suite" >&2
 python tools/perf_gate.py --db "$DB" --suite paged_attn \
   --tolerance "$TOL" --report "$WORKDIR/paged_attn_report.md"
+
+echo "perf_gate_smoke: gating paged_kvq suite" >&2
+python tools/perf_gate.py --db "$DB" --suite paged_kvq \
+  --tolerance "$TOL" --report "$WORKDIR/paged_kvq_report.md"
 
 echo "perf_gate_smoke: gating probe_overhead suite" >&2
 python tools/perf_gate.py --db "$DB" --suite probe_overhead \

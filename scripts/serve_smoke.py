@@ -44,6 +44,14 @@ the live run (same outputs, zero lost, zero retraces, ``trace_counts``
 {1,1}), and one counterfactual (full prefill budget vs the run's
 throttled one) must produce a ranked what-if report.
 
+``--kvq`` runs the quantized-KV-cache arm: one BatchEngine with
+``kv_dtype`` (int8 by default, fp8 via ``--kv-dtype``) on a pool tight
+enough to preempt, serving a shared-prefix workload cold then warm on
+the same engine. FAILS unless the warm outputs — produced from
+CoW-adopted quantized cached blocks — are byte-identical to cold over
+64 decode steps, prefix hits are nonzero, preemption churn actually
+occurred, and ``trace_counts`` stays {1,1}.
+
 ``--replicas N`` (N >= 2) switches to the FLEET path (serving/fleet.py):
 N replicas behind the cache/SLO-aware router. Plain run: everything
 completes, no replica leaves the ROUTABLE states, every replica's two
@@ -813,6 +821,114 @@ def main_whatif(*, seed: int = 0, n_requests: int = 10,
     return result
 
 
+def main_kvq(*, seed: int = 0, kv_dtype: str = "int8", gen: int = 64,
+             perfdb_path: str | None = None) -> dict:
+    """The ``--kvq`` arm: the quantized KV cache's serving contract.
+
+    One quantized BatchEngine (``kv_dtype`` int8 by default) on a pool
+    tight enough that four long generations preempt each other, serving
+    a shared-prefix workload twice:
+
+      * COLD pass: fresh cache — prefills write quantized blocks, the
+        finished sequences donate them to the radix prefix cache.
+      * WARM pass: the SAME requests again — admission must CoW-adopt
+        the quantized cached blocks (nonzero ``prefix_hits``), and every
+        output must be BYTE-IDENTICAL to its cold twin over ``gen`` >= 64
+        decode steps. Per-row scales travel with their blocks, so warm
+        == cold holds exactly in the quantized domain; any scale/block
+        mispairing shows up as token divergence here.
+
+    Also asserted: preemption churn actually happened (the contract is
+    bit-exactness UNDER churn, not in steady state), zero retraces on
+    both compiled steps (``trace_counts`` {1,1} — the quantized arenas
+    ride the same fixed shapes), and pool invariants (free ∪ private ∪
+    cached partition, scale arenas included) after each pass. Raises
+    RuntimeError on any violation."""
+    import jax
+
+    from triton_distributed_tpu.models import Engine, ModelConfig
+    from triton_distributed_tpu.runtime.mesh import make_mesh
+    from triton_distributed_tpu.serving import BatchEngine
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1], set_default=False)
+    config = ModelConfig.from_name("tiny", max_length=256)
+    engine = Engine(config, mesh=mesh, mode="xla", block_n=8)
+    start = time.monotonic()
+
+    rng = np.random.default_rng(seed)
+    n_req = 6
+    prefix = rng.integers(0, config.vocab_size, size=24).tolist()
+    prompts = [prefix + rng.integers(0, config.vocab_size,
+                                     size=4).tolist()
+               for _ in range(n_req)]
+    # Peak residency per request is ceil((28 + gen + 1) / 4) ~ 24 blocks;
+    # 60 blocks cannot hold four of those, so the long decode phase
+    # preempts and re-admits — the churn the bit-exactness claim is about.
+    be = BatchEngine(engine, n_slots=4, n_blocks=60, block_size=4,
+                     prefill_chunk=8, kv_dtype=kv_dtype)
+
+    def one_pass(tag):
+        rids = [be.submit(p, max_new_tokens=gen, req_id=f"{tag}-{i}")
+                for i, p in enumerate(prompts)]
+        done = be.run(max_steps=40000)
+        be.pool.check_invariants()
+        missing = [r for r in rids if r not in done]
+        if missing:
+            raise RuntimeError(f"kvq {tag} pass lost requests: {missing}")
+        return [done[r] for r in rids]
+
+    cold = one_pass("cold")
+    hits_cold = be.metrics.snapshot()["counters"].get("prefix_hits", 0)
+    warm = one_pass("warm")
+    m = be.metrics.snapshot()["counters"]
+    hits_warm = int(m.get("prefix_hits", 0)) - int(hits_cold)
+
+    if warm != cold:
+        bad = [i for i, (a, b) in enumerate(zip(cold, warm)) if a != b]
+        raise RuntimeError(
+            f"quantized warm outputs diverged from cold for requests "
+            f"{bad} — CoW adoption of quantized blocks must be bit-exact "
+            "in the quantized domain")
+    if hits_warm <= 0:
+        raise RuntimeError("warm pass adopted no quantized cached blocks "
+                           "— the radix cache never hit")
+    preemptions = int(m.get("preemptions", 0))
+    if not preemptions:
+        raise RuntimeError("no preemption churn — the pool was sized too "
+                           "generously for the bit-exactness-under-churn "
+                           "claim")
+    for kind, n in be.trace_counts.items():
+        if n > 1:
+            raise RuntimeError(
+                f"{kind} step retraced {n} times — the quantized KV mode "
+                "must keep slot churn data, not shape")
+
+    result = {
+        "kv_dtype": kv_dtype,
+        "kv_fingerprint": be.pool.kv_fingerprint(),
+        "requests_submitted": 2 * n_req,
+        "requests_completed": int(m.get("requests_completed", 0)),
+        "gen": gen,
+        "wall_s": round(time.monotonic() - start, 3),
+        "warm_bit_identical": True,
+        "prefix_hits_warm": hits_warm,
+        "preemptions": preemptions,
+        "trace_count_decode": be.trace_counts["decode"],
+        "trace_count_prefill": be.trace_counts["prefill"],
+    }
+    if perfdb_path:
+        from triton_distributed_tpu.obs.perfdb import PerfDB
+
+        sample = be.perfdb_sample()
+        sample["kvq_prefix_hits"] = float(hits_warm)
+        sample["kvq_preemptions"] = float(preemptions)
+        rec = PerfDB(perfdb_path).append(
+            suite="serve_smoke_kvq", metrics=sample,
+            meta={"seed": seed, "kv_dtype": kv_dtype, "gen": gen})
+        result["perfdb_run_id"] = rec.run_id
+    return result
+
+
 def main(duration_s: float = 30.0, *, rate_hz: float = 4.0, n_slots: int = 4,
          n_blocks: int | None = 12, seed: int = 0, chaos: bool = False,
          perfdb_path: str | None = None, slo: bool = False,
@@ -1031,6 +1147,13 @@ if __name__ == "__main__":
                          "through spec and plain engines; assert zero "
                          "output divergence, nonzero accepted drafts, "
                          "zero retraces")
+    ap.add_argument("--kvq", action="store_true",
+                    help="run the quantized-KV-cache arm: int8 wire dtype, "
+                         "warm CoW-adopted outputs bit-identical to cold "
+                         "over 64 decode steps under preemption churn, "
+                         "nonzero prefix hits, zero retraces")
+    ap.add_argument("--kv-dtype", default="int8",
+                    help="wire dtype for --kvq (int8 or fp8)")
     ap.add_argument("--whatif", action="store_true",
                     help="run the deterministic-replay arm: record a "
                          "short run, replay the baseline bit-identical, "
@@ -1045,7 +1168,16 @@ if __name__ == "__main__":
                          "(tools/serve_top.py tails this file)")
     args = ap.parse_args()
     try:
-        if args.whatif:
+        if args.kvq:
+            if (args.chaos or args.adaptive or args.spec
+                    or args.incidents or args.restore or args.whatif
+                    or args.replicas > 1):
+                raise SystemExit("--kvq is its own arm; run it without "
+                                 "--chaos/--adaptive/--spec/--incidents/"
+                                 "--restore/--whatif/--replicas")
+            metrics = main_kvq(seed=args.seed, kv_dtype=args.kv_dtype,
+                               perfdb_path=args.perfdb)
+        elif args.whatif:
             if (args.chaos or args.adaptive or args.spec
                     or args.incidents or args.restore
                     or args.replicas > 1):

@@ -189,6 +189,32 @@ def test_serve_smoke_spec(tmp_path):
     assert "spec" in snap and "accept_rate" in snap["spec"]
 
 
+def test_serve_smoke_kvq(tmp_path):
+    """The --kvq contract (ISSUE 20): a quantized (int8) engine on a
+    preemption-tight pool serves a shared-prefix workload cold then warm
+    on the SAME engine; the warm outputs — produced from CoW-adopted
+    quantized cached blocks — must be byte-identical to cold over >= 64
+    decode steps, with nonzero prefix hits, actual preemption churn, and
+    trace_counts {1,1} (main_kvq raises on any violation — this test
+    runs that contract under tier 1 and pins the perfdb keys)."""
+    db = tmp_path / "perf.jsonl"
+    m = _load().main_kvq(seed=0, perfdb_path=str(db))
+    assert m["kv_dtype"] == "int8"
+    assert m["kv_fingerprint"] == "int8:rowmax:v1"
+    assert m["warm_bit_identical"] is True
+    assert m["gen"] >= 64
+    assert m["requests_completed"] == m["requests_submitted"] > 0
+    assert m["prefix_hits_warm"] > 0
+    assert m["preemptions"] >= 1
+    assert m["trace_count_decode"] == 1
+    assert m["trace_count_prefill"] == 1
+    rec = json.loads(db.read_text().strip().splitlines()[-1])
+    assert rec["suite"] == "serve_smoke_kvq"
+    assert rec["meta"]["kv_dtype"] == "int8"
+    assert rec["metrics"]["kvq_prefix_hits"] > 0
+    assert rec["metrics"]["kvq_preemptions"] >= 1
+
+
 def test_serve_smoke_chaos():
     """The --chaos mode's graceful-degradation contract: the engine rides
     out injected transient errors and NaN-poisoned rows, finishing with

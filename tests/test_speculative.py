@@ -372,11 +372,17 @@ def test_spec_adaptive_shrinks_to_zero_on_rejection(setup):
     assert m["spec_accepted_tokens"] == 0
 
 
-def test_spec_rollback_then_prefix_cache_warm_equals_cold(setup):
+@pytest.mark.parametrize("kv_dtype", [
+    None, "int8", pytest.param("fp8", marks=pytest.mark.slow)])
+def test_spec_rollback_then_prefix_cache_warm_equals_cold(setup, kv_dtype):
     """A finished request whose KV went through rejection rollbacks
     inserts its blocks into the prefix cache; a warm re-run adopting
     those blocks must match the cold output exactly — truncate never
-    poisons what the cache will later share."""
+    poisons what the cache will later share. The quantized rows replay
+    the same contract on int8/fp8 arenas: truncate decrefs scale blocks
+    in lockstep with wire blocks, so a rolled-back-then-cached block
+    still dequantizes to the cold run's exact values (the f32 golden
+    comparison is skipped there — quantized storage perturbs tokens)."""
     _, config, engine = setup
     rng = np.random.default_rng(6)
     p = rng.integers(0, config.vocab_size, size=9).tolist()
@@ -386,13 +392,15 @@ def test_spec_rollback_then_prefix_cache_warm_equals_cold(setup):
     plan = Speculative(drafter=drafter,
                        controller=SpecController(k_init=2, adaptive=False))
     be = BatchEngine(engine, n_slots=2, block_size=4, prefill_chunk=8,
-                     speculative=plan)
+                     speculative=plan, kv_dtype=kv_dtype)
     be.submit(prompts[0], gens[0], req_id="cold")
     cold = be.run(max_steps=100)
     assert be.metrics.as_dict()["spec_rollback_tokens"] > 0
     be.submit(prompts[0], gens[0], req_id="warm")
     warm = be.run(max_steps=100)
-    assert warm["warm"] == cold["cold"] == gold["cold"]
+    assert warm["warm"] == cold["cold"]
+    if kv_dtype is None:
+        assert cold["cold"] == gold["cold"]
     assert be.metrics.as_dict()["prefix_hits"] >= 1
     be.pool.check_invariants()
     for kind, n in be.trace_counts.items():

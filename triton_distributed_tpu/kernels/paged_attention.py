@@ -75,13 +75,19 @@ _QTILE_CANDIDATES = (64, 32, 16, 8, 4, 2, 1)
 
 
 def _feasible_tiles(block_size: int, n_kv_heads: int, head_dim: int,
-                    max_blocks: int, itemsize: int) -> list[int]:
+                    max_blocks: int, itemsize: int,
+                    kv_scales: bool = False) -> list[int]:
     """Candidate kv tiles whose double (K+V) VMEM staging fits the
     collective staging budget, capped at the table width; heuristic default
     first (largest feasible tile staging <= 512 cache rows — enough DMA
     depth to pipeline against the MXU without hogging VMEM, the
-    flash-decode chunk preference applied to blocks)."""
+    flash-decode chunk preference applied to blocks). ``kv_scales`` bills
+    the quantized pool's extra f32 per-row scale staging (two more
+    buffers, one scale per staged (row, kv head)) — the wire tiles shrink
+    with ``itemsize`` but the scale staging rides the same budget."""
     per_block = 2 * block_size * n_kv_heads * head_dim * itemsize
+    if kv_scales:
+        per_block += 2 * block_size * n_kv_heads * 4
     ok = [t for t in _TILE_CANDIDATES
           if t <= max(1, max_blocks)
           and t * per_block <= common.VMEM_STAGE_BUDGET]
@@ -136,10 +142,16 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
         interleaved_slope_timer,
     )
 
-    itemsize = jnp.dtype(dtype_str).itemsize
+    wire_dt = jnp.dtype(dtype_str)
+    itemsize = wire_dt.itemsize
+    # Quantized pools (int8/fp8 wire dtype): wire tiles shrink, per-row f32
+    # scale staging rides the budget, and queries stage in the COMPUTE
+    # dtype (f32 accumulation — bill 4 bytes, conservative for bf16 q).
+    quant = wire_dt in (jnp.dtype(jnp.int8), jnp.dtype(jnp.float8_e4m3fn))
     kv_cands = _feasible_tiles(block_size, n_kv_heads, head_dim, max_blocks,
-                               itemsize)
-    q_cands = _feasible_qtiles(L, n_kv_heads, g, head_dim, itemsize)
+                               itemsize, kv_scales=quant)
+    q_cands = _feasible_qtiles(L, n_kv_heads, g, head_dim,
+                               4 if quant else itemsize)
     cands = [(t, qt) for qt in q_cands for t in kv_cands]
     if len(cands) == 1:
         return cands[0]
@@ -154,6 +166,8 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
 
         tile, q_tile = cfg
         name = "paged.decode" if L == 1 else "paged.prefill"
+        if quant:
+            name += ".kvq"
         kw = dict(tile_blocks=int(tile), bs=block_size, n_kv=n_kv_heads,
                   dh=head_dim, max_blocks=max_blocks, dtype=dtype_str)
         if L > 1:
@@ -175,14 +189,26 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
         dtype = jnp.dtype(dtype_str)
         n_blocks = B * max_blocks
         key = jax.random.PRNGKey(0)
-        kp = jax.random.normal(
-            key, (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
-        vp = jax.random.normal(
-            jax.random.fold_in(key, 1),
-            (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
+        ks = vs = None
+        if quant:
+            from triton_distributed_tpu.layers.nn import quantize_kv_rows
+
+            kp, ks = quantize_kv_rows(jax.random.normal(
+                key, (n_blocks, block_size, n_kv_heads, head_dim)), dtype)
+            vp, vs = quantize_kv_rows(jax.random.normal(
+                jax.random.fold_in(key, 1),
+                (n_blocks, block_size, n_kv_heads, head_dim)), dtype)
+        else:
+            kp = jax.random.normal(
+                key,
+                (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
+            vp = jax.random.normal(
+                jax.random.fold_in(key, 1),
+                (n_blocks, block_size, n_kv_heads, head_dim)).astype(dtype)
         q = jax.random.normal(
             jax.random.fold_in(key, 2),
-            (B, L, n_kv_heads * g, head_dim)).astype(dtype)
+            (B, L, n_kv_heads * g, head_dim)).astype(
+                jnp.float32 if quant else dtype)
         tables = jnp.arange(B * max_blocks, dtype=jnp.int32).reshape(
             B, max_blocks)
         kv_lens = jnp.full((B,), max_blocks * block_size, jnp.int32)
@@ -196,7 +222,8 @@ def tuned_paged_tile(block_size: int, n_kv_heads: int, head_dim: int,
                 def body(_, acc):
                     out = paged_attention(
                         acc.astype(q.dtype), kp, vp, tables, kv_lens,
-                        q_lens=q_lens, tile_blocks=tile, q_tile=q_tile)
+                        q_lens=q_lens, tile_blocks=tile, q_tile=q_tile,
+                        k_scale=ks, v_scale=vs)
                     return out.astype(jnp.float32)
                 return jax.lax.fori_loop(0, n_iter, body,
                                          q.astype(jnp.float32))
@@ -222,7 +249,8 @@ def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
                        o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems, *,
                        n_tiles: int, tile_blocks: int, bs: int,
                        n_blocks: int, scale: float, n_kv: int, g: int,
-                       q_tile: int, n_q_tiles: int, probe=_probes.NULL):
+                       q_tile: int, n_q_tiles: int, probe=_probes.NULL,
+                       ks_ref=None, vs_ref=None, ks_buf=None, vs_buf=None):
     """One (slot, query-tile, block-tile) grid step of fused paged
     attention.
 
@@ -236,6 +264,14 @@ def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
     their DMA entirely; the row-liveness mask zeroes whatever stale staging
     rows the skipped fetch left behind (``jnp.where`` before the PV dot and
     the ``* valid`` guard on p scrub any NaN/Inf garbage).
+
+    QUANTIZED pools (``ks_ref``/``vs_ref`` given — int8/fp8 wire dtype
+    with per-row f32 scales): the block's scale rows DMA alongside its
+    K/V rows (semaphores 2/3) into ``ks_buf``/``vs_buf``, and dequant
+    happens HERE, right after the pool->VMEM staging — the wire cast to
+    f32 multiplied by the staged scale column — so HBM only ever moves
+    wire bytes while the streaming-softmax math below stays the exact f32
+    accumulation of the unquantized build.
     """
     b = pl.program_id(0)
     qt = pl.program_id(1)
@@ -274,6 +310,13 @@ def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
                 common.local_copy(vp_ref.at[blk],
                                   v_buf.at[pl.ds(i * bs, bs)], sems.at[1],
                                   probe=probe)
+                if ks_buf is not None:
+                    common.local_copy(ks_ref.at[blk],
+                                      ks_buf.at[pl.ds(i * bs, bs)],
+                                      sems.at[2], probe=probe)
+                    common.local_copy(vs_ref.at[blk],
+                                      vs_buf.at[pl.ds(i * bs, bs)],
+                                      sems.at[3], probe=probe)
 
         # Staging rows whose block was never fetched hold garbage (NaN in
         # interpret mode, stale VMEM on hardware). The score-side causal
@@ -289,8 +332,17 @@ def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
             # sub-tiles hit Mosaic's relayout path and measured slower.
             q = q_ref[0, h].astype(jnp.float32)              # (q_tile*g, dh)
             k = k_buf[:, h, :].astype(jnp.float32)           # (T*bs, dh)
+            v = v_buf[:, h, :].astype(jnp.float32)
+            if ks_buf is not None:
+                # In-staging dequant: one f32 scale per staged (row, kv
+                # head), broadcast over head_dim. Stale (unfetched) rows'
+                # garbage products are scrubbed exactly like the
+                # unquantized build: K by the score-side causal mask, V by
+                # the row_live select below.
+                k = k * ks_buf[:, h:h + 1]
+                v = v * vs_buf[:, h:h + 1]
             # where, not multiply: 0 * NaN is still NaN.
-            v = jnp.where(row_live, v_buf[:, h, :].astype(jnp.float32), 0.0)
+            v = jnp.where(row_live, v, 0.0)
             scores = jax.lax.dot_general(
                 q, k, (((1,), (1,)), ((), ()))) * scale      # (q_tile*g, T*bs)
             pos = base + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
@@ -326,27 +378,32 @@ def _paged_attn_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
 def paged_attn_cost(B: int, max_blocks: int, block_size: int,
                     n_kv_heads: int, head_dim: int, *, n_q_heads: int,
                     itemsize: int = 2, L: int = 1,
-                    q_tile: int | None = None):
+                    q_tile: int | None = None,
+                    kv_itemsize: int | None = None,
+                    kv_scales: bool = False):
     """The fused kernel's cost estimate — the causal per-q-tile pass over
     the (worst-case full-table) pool bytes plus q in wire dtype and the f32
     out, delegated to ``runtime.perf_model.paged_attn_bytes`` so the
     estimate, the comm-ledger series, and the bench byte-ratio gate are one
-    arithmetic."""
+    arithmetic. ``kv_itemsize``/``kv_scales``: quantized-pool wire bytes
+    (+ per-row scale reads) — the FLOPs are unchanged because dequant
+    rides the same f32 pipeline."""
     from triton_distributed_tpu.runtime import perf_model as _pm
 
     return common.cost_estimate(
         flops=4 * B * L * n_q_heads * max_blocks * block_size * head_dim,
         bytes_accessed=_pm.paged_attn_bytes(
             B, max_blocks, block_size, n_kv_heads, head_dim,
-            n_q_heads=n_q_heads, itemsize=itemsize, method="fused", L=L,
-            q_tile=q_tile))
+            n_q_heads=n_q_heads, itemsize=itemsize,
+            kv_itemsize=kv_itemsize, kv_scales=kv_scales, method="fused",
+            L=L, q_tile=q_tile))
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                     q_lens=None, slot_mask=None, scale: float | None = None,
                     tile_blocks: int | None = None,
                     q_tile: int | None = None, interpret=None,
-                    probes: bool = False):
+                    probes: bool = False, k_scale=None, v_scale=None):
     """GQA attention of an L-token query block per slot directly over a
     block-paged KV pool — decode (L=1), chunked prefill, and ragged mixed
     steps all through ONE kernel.
@@ -376,6 +433,12 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                   The dead rows' outputs are garbage the caller discards.
     tile_blocks / q_tile: pool blocks and query tokens staged per grid step
                   (None = autotuned / heuristic, ``tuned_paged_tile``).
+    k/v_scale:    (n_blocks, block_size, Hkv) f32 or None — per-row dequant
+                  scales of a QUANTIZED pool (int8/fp8 wire dtype, written
+                  by ``nn.paged_cache_update``'s quantizing append). Given,
+                  each staged block's scale rows DMA with it and the kernel
+                  dequantizes in VMEM before the f32 streaming softmax —
+                  storage precision is the ONLY thing that changes.
     probes:       device-telemetry build (a separate compile): returns
                   ``(out, probe_buf)`` with one record row per (slot,
                   q-tile, kv-tile) grid step, decoded by ``obs.kprobe`` —
@@ -401,6 +464,16 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
     _, max_blocks = block_tables.shape
     g = Hq // Hkv
     scale = dh ** -0.5 if scale is None else scale
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be given together")
+    if quant:
+        if k_scale.shape != k_pool.shape[:3]:
+            raise ValueError(
+                f"k_scale shape {k_scale.shape} != pool rows "
+                f"{k_pool.shape[:3]}")
+        if k_scale.dtype != jnp.float32:
+            raise TypeError(f"scales must be f32, got {k_scale.dtype}")
     if slot_mask is not None:
         block_tables = jnp.where(slot_mask[:, None], block_tables, 0)
     kv_lens = jnp.broadcast_to(
@@ -440,16 +513,33 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                                tile_blocks=tile_blocks, bs=bs,
                                n_blocks=n_blocks, scale=scale, n_kv=Hkv,
                                g=g, q_tile=q_tile, n_q_tiles=n_q_tiles)
+    if quant:
+        # Positional wrapper: the quantized pallas_call passes the scale
+        # pools after V and the scale staging after v_buf; the base kernel
+        # takes them as keywords so one body serves both builds.
+        base_kernel = kernel
+
+        def kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                   ks_ref, vs_ref, o_ref, k_buf, v_buf, ks_buf, vs_buf,
+                   acc_ref, m_ref, l_ref, sems, **kw):
+            base_kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref,
+                        vp_ref, o_ref, k_buf, v_buf, acc_ref, m_ref,
+                        l_ref, sems, ks_ref=ks_ref, vs_ref=vs_ref,
+                        ks_buf=ks_buf, vs_buf=vs_buf, **kw)
+
     out_specs = pl.BlockSpec((1, Hkv, rows, dh),
                              lambda b, qt, t, tbl, kl, ql: (b, 0, qt, 0))
     out_shape = jax.ShapeDtypeStruct((B, Hkv, L_pad * g, dh), jnp.float32)
     scratch_shapes = [
         pltpu.VMEM((tile_blocks * bs, Hkv, dh), k_pool.dtype),  # k stage
         pltpu.VMEM((tile_blocks * bs, Hkv, dh), v_pool.dtype),  # v stage
+        *([pltpu.VMEM((tile_blocks * bs, Hkv), jnp.float32),    # k scales
+           pltpu.VMEM((tile_blocks * bs, Hkv), jnp.float32)]    # v scales
+          if quant else []),
         pltpu.VMEM((Hkv, rows, dh), jnp.float32),   # acc
         pltpu.VMEM((Hkv, rows, 1), jnp.float32),    # running max
         pltpu.VMEM((Hkv, rows, 1), jnp.float32),    # denominator
-        common.dma_sems(2),
+        common.dma_sems(4 if quant else 2),
     ]
     # The probed build serializes every grid dimension so the single
     # ordinal counter ticks in deterministic grid order.
@@ -458,12 +548,23 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
     if probes:
         n_steps = B * n_q_tiles * n_tiles
 
-        def body(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
-                 o_ref, pbuf, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
-                 pord, kernel=kernel):
-            kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
-                   o_ref, k_buf, v_buf, acc_ref, m_ref, l_ref, sems,
-                   probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
+        if quant:
+            def body(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                     ks_ref, vs_ref, o_ref, pbuf, k_buf, v_buf, ks_buf,
+                     vs_buf, acc_ref, m_ref, l_ref, sems, pord,
+                     kernel=kernel):
+                kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref,
+                       vp_ref, ks_ref, vs_ref, o_ref, k_buf, v_buf,
+                       ks_buf, vs_buf, acc_ref, m_ref, l_ref, sems,
+                       probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
+        else:
+            def body(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref, vp_ref,
+                     o_ref, pbuf, k_buf, v_buf, acc_ref, m_ref, l_ref,
+                     sems, pord, kernel=kernel):
+                kernel(tbl_ref, kvlen_ref, qlen_ref, q_ref, kp_ref,
+                       vp_ref, o_ref, k_buf, v_buf, acc_ref, m_ref,
+                       l_ref, sems,
+                       probe=_probes.Probe(pbuf, pord, n_steps=n_steps))
 
         kernel = body
         out_specs = [out_specs, _probes.out_spec()]
@@ -477,10 +578,16 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
                          lambda b, qt, t, tbl, kl, ql: (b, 0, qt, 0)),
             common.any_spec(),     # k pool: manual per-block DMA
             common.any_spec(),     # v pool
+            *([common.any_spec(),  # k scale pool (quantized build)
+               common.any_spec()]  # v scale pool
+              if quant else []),
         ],
         out_specs=out_specs,
         scratch_shapes=scratch_shapes,
     )
+    operands = (block_tables, kv_lens, q_lens, qh, k_pool, v_pool)
+    if quant:
+        operands += (k_scale, v_scale)
     outs = pl.pallas_call(
         kernel,
         out_shape=out_shape,
@@ -489,9 +596,12 @@ def paged_attention(q, k_pool, v_pool, block_tables, kv_lens, *,
             dimension_semantics=dim_sems),
         cost_estimate=paged_attn_cost(
             B, max_blocks, bs, Hkv, dh, n_q_heads=Hq,
-            itemsize=k_pool.dtype.itemsize, L=L, q_tile=q_tile),
+            itemsize=(q.dtype.itemsize if quant
+                      else k_pool.dtype.itemsize),
+            kv_itemsize=k_pool.dtype.itemsize, kv_scales=quant,
+            L=L, q_tile=q_tile),
         interpret=resolve_interpret(interpret),
-    )(block_tables, kv_lens, q_lens, qh, k_pool, v_pool)
+    )(*operands)
     o = outs[0] if probes else outs
     o = o.reshape(B, Hkv, L_pad, g, dh).transpose(0, 2, 1, 3, 4)
     o = o.reshape(B, L_pad, Hq, dh)[:, :L].astype(q.dtype)
@@ -557,10 +667,26 @@ def _paged_trace_body(tbl, kvlen, qlen, q, kp, vp, o, k_buf, v_buf, acc,
                        m_run, l_run, sems, **kw)
 
 
+def _paged_trace_body_kvq(tbl, kvlen, qlen, q, kp, vp, ks, vs, o, k_buf,
+                          v_buf, ks_buf, vs_buf, acc, m_run, l_run, sems,
+                          **kw):
+    # Quantized arg order (scale pools after V, scale staging after v_buf)
+    # mapped onto the one kernel body — mirrors the positional wrapper in
+    # ``paged_attention``.
+    b = int(pl.program_id(0))
+    qt = int(pl.program_id(1))
+    rows = kw["q_tile"] * kw["g"]
+    qw = q.at[pl.ds(b, 1), :, pl.ds(qt * rows, rows)]
+    ow = o.at[pl.ds(b, 1), :, pl.ds(qt * rows, rows)]
+    _paged_attn_kernel(tbl, kvlen, qlen, qw, kp, vp, ow, k_buf, v_buf, acc,
+                       m_run, l_run, sems, ks_ref=ks, vs_ref=vs,
+                       ks_buf=ks_buf, vs_buf=vs_buf, **kw)
+
+
 def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
                 n_kv: int = 2, g: int = 2, dh: int = 128,
                 max_blocks: int = 4, dtype: str = "float32", L: int = 1,
-                q_tile: int = 1) -> "_comm.TraceSpec":
+                q_tile: int = 1, kvq: bool = False) -> "_comm.TraceSpec":
     B = 2
     dt = _np.dtype(jnp.dtype(dtype))
     n_blocks = B * max_blocks
@@ -568,6 +694,9 @@ def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
     n_q_tiles = -(-L // q_tile)
     rows = q_tile * g
     tbl_w = n_tiles * tile_blocks     # host-side right padding, never read
+    # Queries/outputs stay in the COMPUTE dtype on a quantized pool (the
+    # wire dtype only ever holds stored KV rows).
+    qdt = _np.dtype(_np.float32) if kvq else dt
 
     def tables(r, w):
         t = _np.zeros((B, tbl_w), _np.int32)
@@ -576,7 +705,7 @@ def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
         return t
 
     return _comm.TraceSpec(
-        body=_paged_trace_body,
+        body=_paged_trace_body_kvq if kvq else _paged_trace_body,
         ranks=1,
         grid=(B, n_q_tiles, n_tiles),
         args=[
@@ -587,9 +716,12 @@ def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
                                                  _np.int32)),
             _comm.Buf("qlen", (B,), _np.int32, space="smem",
                       init=lambda r, w: _np.full((B,), L, _np.int32)),
-            _comm.Buf("q", (B, n_kv, n_q_tiles * rows, dh), dt),
+            _comm.Buf("q", (B, n_kv, n_q_tiles * rows, dh), qdt),
             _comm.Buf("kp", (n_blocks, bs, n_kv, dh), dt),
             _comm.Buf("vp", (n_blocks, bs, n_kv, dh), dt),
+            *([_comm.Buf("ksp", (n_blocks, bs, n_kv), _np.float32),
+               _comm.Buf("vsp", (n_blocks, bs, n_kv), _np.float32)]
+              if kvq else []),
             # One (1, Hkv, q_tile*g, dh) window of q and o is VMEM-resident
             # per grid step; billing the full B=2 buffers stays within a
             # few KiB of that and keeps the declaration honest.
@@ -599,10 +731,15 @@ def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
                       space="vmem"),
             _comm.Buf("v_buf", (tile_blocks * bs, n_kv, dh), dt,
                       space="vmem"),
+            *([_comm.Buf("ks_buf", (tile_blocks * bs, n_kv), _np.float32,
+                         space="vmem"),
+               _comm.Buf("vs_buf", (tile_blocks * bs, n_kv), _np.float32,
+                         space="vmem")]
+              if kvq else []),
             _comm.Buf("acc", (n_kv, rows, dh), _np.float32, space="vmem"),
             _comm.Buf("m_run", (n_kv, rows, 1), _np.float32, space="vmem"),
             _comm.Buf("l_run", (n_kv, rows, 1), _np.float32, space="vmem"),
-            _comm.Sem("sems", (2,)),
+            _comm.Sem("sems", (4 if kvq else 2,)),
         ],
         kwargs=dict(n_tiles=n_tiles, tile_blocks=tile_blocks, bs=bs,
                     n_blocks=n_blocks, scale=1.0, n_kv=n_kv, g=g,
@@ -611,6 +748,17 @@ def _paged_spec(world: int, *, tile_blocks: int = 2, bs: int = 16,
 
 
 _comm.register("paged.decode")(_paged_spec)
+
+
+@_comm.register("paged.decode.kvq")
+def _paged_spec_kvq(world: int, *, dtype: str = "int8",
+                    **kw) -> "_comm.TraceSpec":
+    """The QUANTIZED pool decode shape: int8 (or fp8) wire-dtype K/V
+    arenas plus per-row f32 scale pools and their VMEM staging pair —
+    proving the dequant-in-staging choreography (two extra DMAs on
+    semaphores 2/3 per staged block) and the shrunken wire footprint the
+    autotuner's bigger quantized tiles rely on."""
+    return _paged_spec(world, dtype=dtype, kvq=True, **kw)
 
 
 @_comm.register("paged.prefill")
@@ -624,12 +772,22 @@ def _paged_spec_prefill(world: int, *, L: int = 8, q_tile: int = 4,
     return _paged_spec(world, L=L, q_tile=q_tile, **kw)
 
 
-def _register_paged_probe(base_name: str) -> None:
+@_comm.register("paged.prefill.kvq")
+def _paged_spec_prefill_kvq(world: int, *, L: int = 8, q_tile: int = 4,
+                            dtype: str = "int8", **kw) -> "_comm.TraceSpec":
+    """Quantized chunked-prefill/mixed shape: the ``paged.prefill`` grid
+    over int8/fp8 wire pools + scale staging (see ``paged.decode.kvq``)."""
+    return _paged_spec(world, L=L, q_tile=q_tile, dtype=dtype, kvq=True,
+                       **kw)
+
+
+def _register_paged_probe(base_name: str, kvq: bool = False) -> None:
     # The generic probes._register_probe_variant appends both probe refs at
     # the end of the arg list; the real probed paged build places probe_buf
     # right after the o output and probe_ord after the scratch refs — the
     # wrapper here mirrors that exact order so the analyzer proves the
-    # choreography the hardware actually runs.
+    # choreography the hardware actually runs. Quantized variants carry the
+    # scale pools before o (probe_buf lands at index 9, not 7).
     @_comm.register(f"{base_name}+probe")
     def _build(world: int, _base=base_name, **cfg) -> "_comm.TraceSpec":
         spec = _comm.get(_base).build(world, **cfg)
@@ -637,15 +795,24 @@ def _register_paged_probe(base_name: str) -> None:
         for n in spec.grid:
             n_steps *= int(n)
 
-        def body(tbl, kvlen, qlen, q, kp, vp, o, pbuf, k_buf, v_buf, acc,
-                 m_run, l_run, sems, pord, **kw):
-            _paged_trace_body(
-                tbl, kvlen, qlen, q, kp, vp, o, k_buf, v_buf, acc, m_run,
-                l_run, sems,
-                probe=_probes.Probe(pbuf, pord, n_steps=n_steps), **kw)
+        if kvq:
+            def body(tbl, kvlen, qlen, q, kp, vp, ks, vs, o, pbuf, k_buf,
+                     v_buf, ks_buf, vs_buf, acc, m_run, l_run, sems, pord,
+                     **kw):
+                _paged_trace_body_kvq(
+                    tbl, kvlen, qlen, q, kp, vp, ks, vs, o, k_buf, v_buf,
+                    ks_buf, vs_buf, acc, m_run, l_run, sems,
+                    probe=_probes.Probe(pbuf, pord, n_steps=n_steps), **kw)
+        else:
+            def body(tbl, kvlen, qlen, q, kp, vp, o, pbuf, k_buf, v_buf,
+                     acc, m_run, l_run, sems, pord, **kw):
+                _paged_trace_body(
+                    tbl, kvlen, qlen, q, kp, vp, o, k_buf, v_buf, acc,
+                    m_run, l_run, sems,
+                    probe=_probes.Probe(pbuf, pord, n_steps=n_steps), **kw)
 
         args = list(spec.args)
-        args.insert(7, _comm.Buf(
+        args.insert(9 if kvq else 7, _comm.Buf(
             "probe_buf", (_probes.n_rows(n_steps), _probes.N_FIELDS),
             _np.int32, space="smem"))
         args.append(_comm.Buf("probe_ord", (1,), _np.int32, space="smem"))
@@ -656,4 +823,6 @@ def _register_paged_probe(base_name: str) -> None:
 
 for _base in ("paged.decode", "paged.prefill"):
     _register_paged_probe(_base)
+for _base in ("paged.decode.kvq", "paged.prefill.kvq"):
+    _register_paged_probe(_base, kvq=True)
 del _base

@@ -89,6 +89,55 @@ def apply_rope(x, cos, sin):
                            axis=-1).astype(x.dtype)
 
 
+# Symmetric per-row quantization range by wire dtype: int8 uses the
+# symmetric [-127, 127] grid (dropping -128 keeps dequant sign-symmetric);
+# float8_e4m3fn saturates at +-448.
+_KV_QMAX = {
+    jnp.dtype(jnp.int8): 127.0,
+    jnp.dtype(jnp.float8_e4m3fn): 448.0,
+}
+
+
+def _kv_qmax(wire_dtype) -> float:
+    try:
+        return _KV_QMAX[jnp.dtype(wire_dtype)]
+    except KeyError:
+        raise ValueError(
+            f"no quantization range for wire dtype {wire_dtype!r}; "
+            f"expected one of {sorted(d.name for d in _KV_QMAX)}") from None
+
+
+def quantize_kv_rows(new, wire_dtype):
+    """Per-row symmetric absmax KV quantization (scheme ``rowmax:v1``).
+
+    ``new`` (..., head_dim) in any float dtype -> ``(q, scale)`` where
+    ``q`` is ``new`` quantized to ``wire_dtype`` and ``scale`` (...,) f32
+    satisfies ``dequantize_kv_rows(q, scale) ~= new``. One scale per
+    (token row, kv head): appending a token NEVER requantizes existing
+    rows, which is what keeps CoW adoption of a quantized cached block
+    bit-exact in the quantized domain (warm == cold byte-for-byte).
+    All-zero rows get scale 0 and dequantize to exact zeros.
+    """
+    dt = jnp.dtype(wire_dtype)
+    qmax = _kv_qmax(dt)
+    xf = new.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = amax / qmax
+    inv = jnp.where(amax > 0.0, qmax / jnp.maximum(amax, 1e-30), 0.0)
+    q = xf * inv[..., None]
+    if dt == jnp.dtype(jnp.int8):
+        q = jnp.clip(jnp.round(q), -qmax, qmax)
+    return q.astype(dt), scale
+
+
+def dequantize_kv_rows(q, scale):
+    """Inverse of ``quantize_kv_rows``: (..., dh) wire values + (...,)
+    f32 per-row scales -> f32. The SAME expression the fused kernel
+    applies in VMEM staging, so the gather oracle and the kernel
+    reconstruct identical values."""
+    return q.astype(jnp.float32) * scale[..., None]
+
+
 def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
                     use_flash_decode: bool = True, seq_lens=None,
                     interpret=None):
@@ -212,7 +261,8 @@ def attn_with_cache(q, k_cache, v_cache, offset, *, scale: float,
 def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
                           scale: float, slot_mask=None,
                           use_flash_decode: bool = True, seq_lens=None,
-                          interpret=None, paged_attn: str = "fused"):
+                          interpret=None, paged_attn: str = "fused",
+                          kv_scales=None):
     """GQA attention of new queries against a BLOCK-PAGED KV pool — the
     paged twin of ``attn_with_cache``.
 
@@ -235,6 +285,13 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
     BEFORE this step; slot_mask: (B,) bool dead-slot mask (dead rows'
     outputs are garbage the serving engine discards). -> (B, L, Hq, dh).
 
+    ``kv_scales`` — ``(k_scale, v_scale)``, each (n_blocks, block_size,
+    Hkv) f32 — marks the pool QUANTIZED (int8/fp8 wire dtype, per-row
+    scales from ``quantize_kv_rows``): the fused kernel dequantizes in
+    VMEM staging right after the pool->VMEM DMA, the gather oracle
+    dequantizes its materialized view with ``dequantize_kv_rows``, and
+    the ledger bills the halved wire bytes (+ scale reads).
+
     When the comm ledger is enabled, records a ``paged_attn`` series with
     the analytic ``perf_model.paged_attn_bytes`` for whichever method ran
     (``fused_decode`` / ``fused_prefill`` / ``gather``) — the roofline
@@ -248,6 +305,11 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
     B, L, Hq, dh = q.shape
     fused = paged_attn == "fused"
     Hkv = k_pool.shape[2]
+    quant = kv_scales is not None
+    if quant and kv_scales[0].shape != k_pool.shape[:3]:
+        raise ValueError(
+            f"kv_scales shape {kv_scales[0].shape} does not match pool "
+            f"rows {k_pool.shape[:3]}")
 
     from triton_distributed_tpu.obs import comm_ledger as _ledger
 
@@ -272,8 +334,11 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
                 str(k_pool.dtype), L=L, g=Hq // Hkv)
         nbytes = pm.paged_attn_bytes(
             B, block_tables.shape[1], k_pool.shape[1], Hkv, dh,
-            n_q_heads=Hq, itemsize=k_pool.dtype.itemsize, method=method,
-            L=L, q_tile=q_tile)
+            n_q_heads=Hq,
+            itemsize=(q.dtype.itemsize if quant
+                      else k_pool.dtype.itemsize),
+            kv_itemsize=k_pool.dtype.itemsize, kv_scales=quant,
+            method=method, L=L, q_tile=q_tile)
         _ledger.record_traced(
             "paged_attn", axis="local", world=1, nbytes=nbytes,
             method=method, est_s=nbytes / pm.detect_hardware().hbm_bw)
@@ -292,12 +357,24 @@ def paged_attn_with_cache(q, k_pool, v_pool, block_tables, offset, *,
                 jnp.asarray(seq_lens, jnp.int32).reshape(-1), (B,))
         return paged_attention(
             q, k_pool, v_pool, block_tables, off + q_lens, q_lens=q_lens,
-            slot_mask=slot_mask, scale=scale, interpret=interpret)
+            slot_mask=slot_mask, scale=scale, interpret=interpret,
+            k_scale=kv_scales[0] if quant else None,
+            v_scale=kv_scales[1] if quant else None)
 
     from triton_distributed_tpu.kernels.sp_attention import paged_gather_kv
 
     k_view = paged_gather_kv(k_pool, block_tables, slot_mask=slot_mask)
     v_view = paged_gather_kv(v_pool, block_tables, slot_mask=slot_mask)
+    if quant:
+        # Oracle-side dequant: gather the per-row scales through the SAME
+        # table walk, reconstruct f32 views (identical expression to the
+        # kernel's in-VMEM dequant), and run the dense reference on those.
+        ks_view = paged_gather_kv(kv_scales[0], block_tables,
+                                  slot_mask=slot_mask)
+        vs_view = paged_gather_kv(kv_scales[1], block_tables,
+                                  slot_mask=slot_mask)
+        k_view = dequantize_kv_rows(k_view, ks_view)
+        v_view = dequantize_kv_rows(v_view, vs_view)
     return attn_with_cache(q, k_view, v_view, offset, scale=scale,
                            use_flash_decode=use_flash_decode,
                            seq_lens=seq_lens, interpret=interpret)
@@ -321,7 +398,8 @@ def cache_update(cache, new, offset):
     return cache.at[jnp.arange(B)[:, None], pos].set(new.astype(cache.dtype))
 
 
-def paged_cache_update(pool, new, block_tables, offsets, write_mask=None):
+def paged_cache_update(pool, new, block_tables, offsets, write_mask=None,
+                       scale_pool=None):
     """Write ``new`` (B, L, H, dh) into a block-paged KV pool layer
     (n_blocks, block_size, H, dh) at per-slot positions — the
     PagedAttention write: token (b, l) lands in block
@@ -333,6 +411,13 @@ def paged_cache_update(pool, new, block_tables, offsets, write_mask=None):
     DROPS masked writes entirely (routed out of range under scatter mode
     'drop'), so inactive slots and padding rows can never corrupt blocks
     owned by live sequences.
+
+    ``scale_pool`` — (n_blocks, block_size, H) f32 — marks the pool
+    QUANTIZED: ``new`` is quantized per row (``quantize_kv_rows``) to the
+    pool's wire dtype INSIDE this compiled append, and the row scales are
+    scattered through the identical (block, line) indexing (same drop
+    mask), so a KV row and its scale can never land in different blocks.
+    Returns ``(pool, scale_pool)`` instead of ``pool``.
     """
     B, L = new.shape[:2]
     n_blocks, bs = pool.shape[:2]
@@ -345,4 +430,9 @@ def paged_cache_update(pool, new, block_tables, offsets, write_mask=None):
     if write_mask is not None:
         wm = (write_mask if write_mask.ndim == 2 else write_mask[:, None])
         blk = jnp.where(wm, blk, n_blocks)          # out of range -> dropped
-    return pool.at[blk, pos % bs].set(new.astype(pool.dtype), mode="drop")
+    if scale_pool is None:
+        return pool.at[blk, pos % bs].set(new.astype(pool.dtype),
+                                          mode="drop")
+    q, scales = quantize_kv_rows(new, pool.dtype)
+    return (pool.at[blk, pos % bs].set(q, mode="drop"),
+            scale_pool.at[blk, pos % bs].set(scales, mode="drop"))

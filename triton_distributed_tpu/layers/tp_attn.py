@@ -131,7 +131,7 @@ class TPAttn:
     def _qkv_to_attn(self, params, qkv, k_cache, v_cache, offset, world,
                      use_flash_decode: bool = True, seq_lens=None,
                      interpret=None, block_tables=None, slot_mask=None,
-                     paged_attn: str = "fused"):
+                     paged_attn: str = "fused", kv_scales=None):
         """qkv (B, L, q_size+2*kv_size) local-head projection -> attention
         output (B, L, q_size) plus updated caches. The qk-norm -> RoPE ->
         cache-append -> GQA-attend pipeline shared by every mode
@@ -157,6 +157,14 @@ class TPAttn:
           the explicit paged_gather_kv escape hatch / test oracle —
           either way arriving/finishing sequences are pure DATA changes
           and the step never retraces.
+
+        Quantized paged KV (``kv_scales`` = (k_scale, v_scale) pool
+        arenas, each (n_blocks, block_size, Hkv) f32): the pool arenas
+        hold int8/fp8 rows, new K/V are quantized per (row, kv head) at
+        append time (``nn.paged_cache_update(scale_pool=...)``), and the
+        attention read dequantizes — inside the fused kernel's VMEM
+        staging, or on the gathered view in gather mode. Returns an
+        extra 4th element, the updated ``(k_scale, v_scale)`` tuple.
         """
         B, L, _ = qkv.shape
         qs, kvs = self.sizes(world)
@@ -175,6 +183,9 @@ class TPAttn:
         q = nn.apply_rope(q, cos, sin)
         k = nn.apply_rope(k, cos, sin)
         if block_tables is None:
+            if kv_scales is not None:
+                raise ValueError("kv_scales requires the paged cache "
+                                 "layout (block_tables)")
             k_cache = nn.cache_update(k_cache, k, offset)
             v_cache = nn.cache_update(v_cache, v, offset)
             out = nn.attn_with_cache(q, k_cache, v_cache, offset,
@@ -187,6 +198,19 @@ class TPAttn:
         if seq_lens is not None:
             tok_valid = jnp.arange(L)[None] < seq_lens[:, None]
             wm = tok_valid if wm is None else (wm[:, None] & tok_valid)
+        if kv_scales is not None:
+            k_cache, ks = nn.paged_cache_update(k_cache, k, block_tables,
+                                                offset, wm,
+                                                scale_pool=kv_scales[0])
+            v_cache, vs = nn.paged_cache_update(v_cache, v, block_tables,
+                                                offset, wm,
+                                                scale_pool=kv_scales[1])
+            out = nn.paged_attn_with_cache(
+                q, k_cache, v_cache, block_tables, offset, scale=dh ** -0.5,
+                slot_mask=slot_mask, use_flash_decode=use_flash_decode,
+                seq_lens=seq_lens, interpret=interpret,
+                paged_attn=paged_attn, kv_scales=(ks, vs))
+            return out.reshape(B, L, qs), k_cache, v_cache, (ks, vs)
         k_cache = nn.paged_cache_update(k_cache, k, block_tables,
                                         offset, wm)
         v_cache = nn.paged_cache_update(v_cache, v, block_tables,
@@ -203,48 +227,57 @@ class TPAttn:
 
     def dist_fwd(self, params, x_local, k_cache, v_cache, offset, *,
                  seq_lens=None, interpret=None, block_tables=None,
-                 slot_mask=None, paged_attn: str = "fused"):
+                 slot_mask=None, paged_attn: str = "fused", kv_scales=None):
         """x_local: (B_local, L, d) batch-shard -> same layout out.
         AG-GEMM -> attention -> GEMM-RS (reference dist_triton_fwd :203).
         ``seq_lens``: (B,) varlen prefill lengths (nn.attn_with_cache).
         ``block_tables``/``slot_mask``/``paged_attn``: paged-KV serving
         path (``_qkv_to_attn``) — tables/mask cover the FULL batch,
-        replicated."""
+        replicated. ``kv_scales`` (quantized paged pool) appends the
+        updated (k_scale, v_scale) tuple as a 4th output."""
         world = _axis_size(self.axis)
         Bl, L, d = x_local.shape
         qkv = ag_gemm_device(
             x_local.reshape(Bl * L, d), params["w_qkv"], axis=self.axis,
             config=AGGEMMConfig(block_n=self.block_n), interpret=interpret)
         qkv = qkv.reshape(world * Bl, L, -1)
-        out, k_cache, v_cache = self._qkv_to_attn(
+        res = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world, seq_lens=seq_lens,
             interpret=interpret, block_tables=block_tables,
-            slot_mask=slot_mask, paged_attn=paged_attn)
+            slot_mask=slot_mask, paged_attn=paged_attn, kv_scales=kv_scales)
+        out, k_cache, v_cache = res[:3]
         out = gemm_rs_device(
             out.reshape(world * Bl * L, -1), params["w_o"], axis=self.axis,
             config=GEMMRSConfig(block_n=min(self.block_n, self.d_model)),
             interpret=interpret)
-        return out.reshape(Bl, L, d), k_cache, v_cache
+        out = out.reshape(Bl, L, d)
+        if kv_scales is not None:
+            return out, k_cache, v_cache, res[3]
+        return out, k_cache, v_cache
 
     def ar_fwd(self, params, x_full, k_cache, v_cache, offset, *,
                interpret=None, seq_lens=None, block_tables=None,
-               slot_mask=None, paged_attn: str = "fused"):
+               slot_mask=None, paged_attn: str = "fused", kv_scales=None):
         """x_full: (B, L, d) replicated -> replicated out.
         Local GEMMs -> one-shot allreduce (reference dist_triton_AR_fwd)."""
         world = _axis_size(self.axis)
         B, L, d = x_full.shape
         qkv = x_full @ params["w_qkv"]
-        out, k_cache, v_cache = self._qkv_to_attn(
+        res = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world, interpret=interpret,
             seq_lens=seq_lens, block_tables=block_tables,
-            slot_mask=slot_mask, paged_attn=paged_attn)
+            slot_mask=slot_mask, paged_attn=paged_attn, kv_scales=kv_scales)
+        out, k_cache, v_cache = res[:3]
         partial = out.reshape(B * L, -1) @ params["w_o"]
         out = oneshot_all_reduce(partial, axis=self.axis, interpret=interpret)
-        return out.reshape(B, L, d), k_cache, v_cache
+        out = out.reshape(B, L, d)
+        if kv_scales is not None:
+            return out, k_cache, v_cache, res[3]
+        return out, k_cache, v_cache
 
     def xla_fwd(self, params, x_local, k_cache, v_cache, offset, *,
                 seq_lens=None, block_tables=None, slot_mask=None,
-                paged_attn: str = "fused"):
+                paged_attn: str = "fused", kv_scales=None):
         """Golden/baseline path: same math via jnp + XLA collectives.
         Batch-sharded in/out like ``dist_fwd``. ``paged_attn`` still
         routes paged decode through the fused kernel (interpret mode on
@@ -255,12 +288,16 @@ class TPAttn:
         x_full = jax.lax.all_gather(x_local, self.axis, axis=0, tiled=True)
         qkv = x_full.reshape(world * Bl * L, d) @ params["w_qkv"]
         qkv = qkv.reshape(world * Bl, L, -1)
-        out, k_cache, v_cache = self._qkv_to_attn(
+        res = self._qkv_to_attn(
             params, qkv, k_cache, v_cache, offset, world,
             use_flash_decode=False, seq_lens=seq_lens,
             block_tables=block_tables, slot_mask=slot_mask,
-            paged_attn=paged_attn)
+            paged_attn=paged_attn, kv_scales=kv_scales)
+        out, k_cache, v_cache = res[:3]
         partial = out.reshape(world * Bl * L, -1) @ params["w_o"]
         out = jax.lax.psum_scatter(partial, self.axis, scatter_dimension=0,
                                    tiled=True)
-        return out.reshape(Bl, L, d), k_cache, v_cache
+        out = out.reshape(Bl, L, d)
+        if kv_scales is not None:
+            return out, k_cache, v_cache, res[3]
+        return out, k_cache, v_cache

@@ -71,7 +71,7 @@ class Engine:
 
     def _make_sm(self, mode: str, *, moe_stats: bool = False,
                  paged: str | None = None, paged_attn: str = "fused",
-                 spec_verify: bool = False):
+                 spec_verify: bool = False, kv_quant: bool = False):
         """The per-mode shard_map of the model forward — the ONE definition
         of the step sharding, shared by the per-step jit (``_step_fn``),
         the scanned loop (``_serve_scanned_fn``), and the drop-stats audit
@@ -93,22 +93,44 @@ class Engine:
         the argmax continuation at every position — between the logits and
         the donated pool arrays. Same shapes, same sharding, one extra
         replicated output; a speculative BatchEngine bakes it into its one
-        mixed-step trace."""
+        mixed-step trace.
+
+        ``kv_quant=True`` (paged variants only) is the quantized-pool
+        shape of the same step: two per-row f32 scale arenas ride along
+        right after the K/V pools — same kv-head sharding minus head_dim
+        (``KVCache.scale_spec``) — both as operands and as outputs, so
+        the serving engine can donate them alongside the pools."""
         model = self.model
         kspec, vspec, _ = KVCache.spec(model.axis)
+        sspec = KVCache.scale_spec(model.axis)
         if spec_verify and paged != "prefill":
             raise ValueError("spec_verify requires the paged='prefill' "
                              "(varlen mixed step) variant")
+        if kv_quant and paged is None:
+            raise ValueError("kv_quant requires a paged variant (the "
+                             "contiguous Engine cache is unquantized)")
+        kv_out = ((kspec, vspec, sspec, sspec) if kv_quant
+                  else (kspec, vspec))
         if spec_verify:
-            out_specs = (P(), P(), kspec, vspec)
+            out_specs = (P(), P()) + kv_out
         else:
-            out_specs = ((P(), kspec, vspec, P()) if moe_stats
-                         else (P(), kspec, vspec))
+            out_specs = ((P(),) + kv_out + (P(),) if moe_stats
+                         else (P(),) + kv_out)
         if paged is None:
             fwd = functools.partial(model.forward_device, mode=mode,
                                     interpret=self.interpret,
                                     return_moe_stats=moe_stats)
             in_specs = (model.param_specs(), P(), kspec, vspec, P())
+        elif paged == "decode" and kv_quant:
+            def fwd(params, ids, kp, vp, ksp, vsp, offsets, block_tables,
+                    slot_mask):
+                return model.forward_device(
+                    params, ids, kp, vp, offsets, mode=mode,
+                    interpret=self.interpret, block_tables=block_tables,
+                    slot_mask=slot_mask, paged_attn=paged_attn,
+                    kv_scales=(ksp, vsp))
+            in_specs = (model.param_specs(), P(), kspec, vspec,
+                        sspec, sspec, P(), P(), P())
         elif paged == "decode":
             def fwd(params, ids, kp, vp, offsets, block_tables, slot_mask):
                 return model.forward_device(
@@ -117,6 +139,17 @@ class Engine:
                     slot_mask=slot_mask, paged_attn=paged_attn)
             in_specs = (model.param_specs(), P(), kspec, vspec,
                         P(), P(), P())
+        elif paged == "prefill" and kv_quant:
+            def fwd(params, ids, kp, vp, ksp, vsp, offsets, block_tables,
+                    slot_mask, seq_lens):
+                return model.forward_device(
+                    params, ids, kp, vp, offsets, mode=mode,
+                    interpret=self.interpret, block_tables=block_tables,
+                    slot_mask=slot_mask, seq_lens=seq_lens,
+                    paged_attn=paged_attn, spec_verify=spec_verify,
+                    kv_scales=(ksp, vsp))
+            in_specs = (model.param_specs(), P(), kspec, vspec,
+                        sspec, sspec, P(), P(), P(), P())
         elif paged == "prefill":
             def fwd(params, ids, kp, vp, offsets, block_tables, slot_mask,
                     seq_lens):

@@ -55,6 +55,15 @@ class KVCache:
         kv = P(None, None, None, axis, None)
         return kv, kv, P()
 
+    @staticmethod
+    def scale_spec(axis: str = "tp"):
+        """PartitionSpec for a quantized pool's per-row scale arena
+        (n_layers, n_blocks, block_size, n_kv_heads) — same kv-head
+        sharding as ``spec`` minus the head_dim axis the scales reduce
+        over (serving/kv_pool.py allocates one f32 scale per (block row,
+        kv head))."""
+        return P(None, None, None, axis)
+
     def clear(self) -> "KVCache":
         return KVCache(k=self.k, v=self.v, offset=jnp.int32(0))
 

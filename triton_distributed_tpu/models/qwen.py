@@ -272,7 +272,8 @@ class Qwen3:
                        mode: str = "dist", interpret=None,
                        return_moe_stats: bool = False, seq_lens=None,
                        block_tables=None, slot_mask=None,
-                       paged_attn: str = "fused", spec_verify: bool = False):
+                       paged_attn: str = "fused", spec_verify: bool = False,
+                       kv_scales=None):
         """One forward step on this device.
 
         ids: (B, L) int32, replicated. k/v_cache: this device's shard
@@ -294,6 +295,11 @@ class Qwen3:
                        through the fused block-walk kernel; "gather" pins
                        the materialized-view escape hatch / test oracle
                        (nn.paged_attn_with_cache).
+          kv_scales    (k_scale, v_scale) per-layer scale arenas
+                       (n_layers, n_blocks, block_size, local_kv_heads)
+                       f32 when the paged pool stores quantized int8/fp8
+                       KV; the updated pair comes back as two extra
+                       outputs right after (new_k, new_v).
 
         ``spec_verify=True`` (speculative decoding's batched verify;
         requires ``seq_lens``) inserts a SECOND output after ``logits``:
@@ -344,6 +350,10 @@ class Qwen3:
         if spec_verify and seq_lens is None:
             raise ValueError("spec_verify requires seq_lens (the batched "
                              "verify step is a varlen mixed step)")
+        quant = kv_scales is not None
+        if quant and block_tables is None:
+            raise ValueError("kv_scales requires the paged cache layout "
+                             "(block_tables)")
         if spec_verify and return_moe_stats:
             raise ValueError("spec_verify and return_moe_stats outputs "
                              "are mutually exclusive")
@@ -365,29 +375,37 @@ class Qwen3:
             scan_layers["mlp"] = lp_mlp
 
         def body(h, xs):
-            lp, kc, vc, li = xs
+            if quant:
+                lp, kc, vc, ksc, vsc, li = xs
+                sc = (ksc, vsc)
+            else:
+                lp, kc, vc, li = xs
+                sc = None
             resid = h
             hn = nn.rms_norm(h, lp["input_norm"], c.rms_eps)
             if mode == "dist":
-                a, kc, vc = attn.dist_fwd(lp["attn"], hn, kc, vc, offset,
-                                          interpret=interpret,
-                                          seq_lens=seq_lens,
-                                          block_tables=block_tables,
-                                          slot_mask=slot_mask,
-                                          paged_attn=paged_attn)
+                res = attn.dist_fwd(lp["attn"], hn, kc, vc, offset,
+                                    interpret=interpret,
+                                    seq_lens=seq_lens,
+                                    block_tables=block_tables,
+                                    slot_mask=slot_mask,
+                                    paged_attn=paged_attn, kv_scales=sc)
             elif mode == "xla":
-                a, kc, vc = attn.xla_fwd(lp["attn"], hn, kc, vc, offset,
-                                         seq_lens=seq_lens,
-                                         block_tables=block_tables,
-                                         slot_mask=slot_mask,
-                                         paged_attn=paged_attn)
+                res = attn.xla_fwd(lp["attn"], hn, kc, vc, offset,
+                                   seq_lens=seq_lens,
+                                   block_tables=block_tables,
+                                   slot_mask=slot_mask,
+                                   paged_attn=paged_attn, kv_scales=sc)
             else:
-                a, kc, vc = attn.ar_fwd(lp["attn"], hn, kc, vc, offset,
-                                        interpret=interpret,
-                                        seq_lens=seq_lens,
-                                        block_tables=block_tables,
-                                        slot_mask=slot_mask,
-                                        paged_attn=paged_attn)
+                res = attn.ar_fwd(lp["attn"], hn, kc, vc, offset,
+                                  interpret=interpret,
+                                  seq_lens=seq_lens,
+                                  block_tables=block_tables,
+                                  slot_mask=slot_mask,
+                                  paged_attn=paged_attn, kv_scales=sc)
+            a, kc, vc = res[:3]
+            if quant:
+                ksc, vsc = res[3]
             h = resid + a
             resid = h
             hn = nn.rms_norm(h, lp["post_norm"], c.rms_eps)
@@ -409,19 +427,30 @@ class Qwen3:
             else:
                 m = mlp.ar_fwd(lp["mlp"], flat, interpret=interpret)
             h = resid + m.reshape(hn.shape)
+            tail = (kc, vc, ksc, vsc) if quant else (kc, vc)
             if return_moe_stats:
-                return h, (kc, vc, stats)
-            return h, (kc, vc)
+                return h, tail + (stats,)
+            return h, tail
 
         layer_ids = jnp.arange(c.n_layers, dtype=jnp.int32)
+        xs = ((scan_layers, k_cache, v_cache, kv_scales[0], kv_scales[1],
+               layer_ids) if quant
+              else (scan_layers, k_cache, v_cache, layer_ids))
+        new_ks = new_vs = None
         if return_moe_stats:
-            h, (new_k, new_v, layer_stats) = jax.lax.scan(
-                body, h, (scan_layers, k_cache, v_cache, layer_ids))
+            h, ys = jax.lax.scan(body, h, xs)
+            if quant:
+                new_k, new_v, new_ks, new_vs, layer_stats = ys
+            else:
+                new_k, new_v, layer_stats = ys
             moe_stats = jax.tree.map(
                 lambda x: jax.lax.psum(jnp.sum(x), self.axis), layer_stats)
         else:
-            h, (new_k, new_v) = jax.lax.scan(
-                body, h, (scan_layers, k_cache, v_cache, layer_ids))
+            h, ys = jax.lax.scan(body, h, xs)
+            if quant:
+                new_k, new_v, new_ks, new_vs = ys
+            else:
+                new_k, new_v = ys
 
         h = nn.rms_norm(h, params["final_norm"], c.rms_eps)
         lm_head = (params["embed"].T if c.tie_embeddings
@@ -455,8 +484,10 @@ class Qwen3:
             last = jax.lax.all_gather(last, self.axis, axis=0, tiled=True)
         # bf16 operands, fp32 accumulation — no materialized fp32 weight copy
         logits = jnp.dot(last, lm_head, preferred_element_type=jnp.float32)
+        kv_out = ((new_k, new_v, new_ks, new_vs) if quant
+                  else (new_k, new_v))
         if spec_verify:
-            return logits, greedy, new_k, new_v
+            return (logits, greedy) + kv_out
         if return_moe_stats:
-            return logits, new_k, new_v, moe_stats
-        return logits, new_k, new_v
+            return (logits,) + kv_out + (moe_stats,)
+        return (logits,) + kv_out

@@ -275,7 +275,14 @@ _LOWER_BETTER_OVERRIDES = ("bytes_ratio", "frag_frac", "overhead_frac",
                            # better; "recovery_s" rides the "_s" latency
                            # suffix. "lost_requests" (requests the
                            # restore could not reconstruct) must be 0.
-                           "incident", "replay", "lost_requests")
+                           "incident", "replay", "lost_requests",
+                           # "kv_bytes_per_token" (quantized KV cache:
+                           # modeled pool bytes one decoded token streams)
+                           # — the whole point of kv_dtype="int8"/"fp8"
+                           # is shrinking it. "kv_quant_overhead_frac"
+                           # (scale-arena bytes over KV bytes) rides the
+                           # "overhead_frac" override + abs slack above.
+                           "kv_bytes_per_token")
 _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         "speedup", "vs_baseline", "goodput", "ratio",
                         "_completed", "requests_ok", "flops", "gbps",
@@ -284,7 +291,12 @@ _HIGHER_BETTER_HINTS = ("tokens_per_s", "per_s", "_frac", "efficiency",
                         # is the whole point. accept_rate (speculative
                         # decoding): fraction of drafted tokens the model
                         # verified — more free tokens per step.
-                        "hit_rate", "mfu", "mbu", "accept_rate")
+                        # divergence_len (quantized-KV accuracy proxy):
+                        # greedy tokens emitted before the quantized run
+                        # first diverges from the full-precision run —
+                        # longer agreement is strictly better.
+                        "hit_rate", "mfu", "mbu", "accept_rate",
+                        "divergence_len")
 _LATENCY_SUFFIXES = ("_ms", "_us", "_ns", "_s")
 
 # Metrics recorded for CONTEXT, consciously ungated: workload-scaled
@@ -321,6 +333,10 @@ NEUTRAL_CONTEXT = frozenset({
     # bench asserts gate the replay directly (bit-identical, planted
     # winner), not the perfdb delta.
     "whatif_requests", "whatif_configs", "whatif_calib_samples",
+    # quantized-KV arm context (bench --paged-attn --kv-dtype /
+    # serve_smoke --kvq): configuration echoes and exercise witnesses —
+    # the arms assert on them directly (nonzero hits, warm == cold).
+    "paged_kvq_dtype", "paged_kvq_prefill_chunk", "kvq_prefix_hits",
 })
 
 

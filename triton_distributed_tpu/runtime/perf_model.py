@@ -269,7 +269,9 @@ def wire_bytes_all_to_all(per_dev_nbytes: int, world: int) -> int:
 def paged_attn_bytes(B: int, max_blocks: int, block_size: int,
                      n_kv_heads: int, head_dim: int, *, n_q_heads: int,
                      itemsize: int = 2, method: str = "fused", L: int = 1,
-                     q_tile: int | None = None) -> int:
+                     q_tile: int | None = None,
+                     kv_itemsize: int | None = None,
+                     kv_scales: bool = False) -> int:
     """HBM bytes one paged-attention step moves reading a block-paged KV
     pool (per layer, per device shard, worst case: every table full).
 
@@ -290,9 +292,22 @@ def paged_attn_bytes(B: int, max_blocks: int, block_size: int,
     comm ledger records this next to the achieved wall time, so the
     fused-vs-gather ratio in bench.py's ``paged_attn`` arm is this exact
     arithmetic.
+
+    QUANTIZED pools: ``kv_itemsize`` is the WIRE itemsize the pool bytes
+    actually move in (int8/fp8: 1; default = ``itemsize``, the
+    compute/activation width q and the gather views move in) and
+    ``kv_scales`` bills the per-row f32 scale arena (2 * Hkv * 4 bytes
+    per KV row) alongside its blocks. On the fused path every pool touch
+    shrinks to wire width; on the gather path only the FIRST touch (the
+    pool read that builds the view) is wire-width — the materialized view
+    is dequantized to the compute dtype, so its write + attention read
+    stay at ``itemsize``.
     """
     S = max_blocks * block_size
-    kv_row = 2 * n_kv_heads * head_dim * itemsize         # K + V, one row
+    kv_itemsize = itemsize if kv_itemsize is None else kv_itemsize
+    kv_row = 2 * n_kv_heads * head_dim * kv_itemsize      # K + V, one row
+    if kv_scales:
+        kv_row += 2 * n_kv_heads * 4                      # f32 scale pair
     q_out = B * L * n_q_heads * head_dim * (itemsize + 4)  # wire q, f32 out
     if method in ("fused", "fused_decode", "fused_prefill"):
         qt = L if q_tile is None else max(1, min(int(q_tile), L))
@@ -305,7 +320,8 @@ def paged_attn_bytes(B: int, max_blocks: int, block_size: int,
                         -(-max(0, limit) // block_size)) * block_size
         return q_out + B * rows * kv_row
     if method == "gather":
-        return q_out + 3 * B * S * kv_row
+        view_row = 2 * n_kv_heads * head_dim * itemsize   # dequantized view
+        return q_out + B * S * (kv_row + 2 * view_row)
     raise ValueError(
         f"method must be 'fused', 'fused_decode', 'fused_prefill' or "
         f"'gather', got {method!r}")
@@ -363,19 +379,26 @@ def step_flops(config, rows) -> float:
 
 def step_hbm_bytes(config, rows, *, block_size: int = 16,
                    itemsize: int = 2, method: str = "fused",
-                   q_tile: int | None = None) -> float:
+                   q_tile: int | None = None,
+                   kv_itemsize: int | None = None,
+                   kv_scales: bool = False) -> float:
     """Modeled HBM bytes of one serving step: the weight stream (every
     active weight matrix read once per step — batched rows amortize it)
     plus, per row and per layer, the block-paged KV pool traffic of
     ``paged_attn_bytes`` over the blocks the row's ``kv_len`` context
     occupies. Same byte model the comm ledger and the ``--paged-attn``
     bench arm gate against, so the efficiency ledger's MBU and the kernel
-    byte-ratio gates can never disagree on what a step should move."""
+    byte-ratio gates can never disagree on what a step should move.
+    ``kv_itemsize``/``kv_scales``: the quantized pool's wire width and
+    scale-arena bytes, forwarded per row — a ``kv_dtype="int8"`` engine's
+    modeled bytes per decode step visibly drop while its step flops
+    don't, which is exactly the MBU rise the efficiency ledger reports."""
     total = float(matmul_params(config)) * itemsize
     for q, kv in rows:
         blocks = max(1, -(-int(kv) // block_size))
         total += config.n_layers * paged_attn_bytes(
             1, blocks, block_size, config.n_kv_heads, config.head_dim,
             n_q_heads=config.n_heads, itemsize=itemsize, method=method,
-            L=max(1, int(q)), q_tile=q_tile)
+            L=max(1, int(q)), q_tile=q_tile, kv_itemsize=kv_itemsize,
+            kv_scales=kv_scales)
     return total

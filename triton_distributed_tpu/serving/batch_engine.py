@@ -120,6 +120,16 @@ class BatchEngine:
                    ALWAYS compiled into the steps (SPMD safety — see
                    module docstring); this flag only enables the host-side
                    check of it.
+    ``kv_dtype``   wire format of the KV pool: None (default) stores KV
+                   in the model dtype; "int8"/"fp8" store quantized rows
+                   plus per-(row, kv-head) f32 scales in two extra pool
+                   arenas that ride the compiled steps as donated
+                   operands. Quantization happens at append time inside
+                   the step; dequantization happens inside the fused
+                   kernel's VMEM staging (or on the gathered view in
+                   gather mode), so pool HBM traffic shrinks by the
+                   dtype ratio. Same two traces, same shapes —
+                   ``trace_counts`` stays {1,1}.
     ``paged_attn`` "fused" (default): every step shape — decode, chunked
                    prefill, ragged mixed — walks the block table inside
                    the Pallas kernel, one pass over the pool bytes.
@@ -178,6 +188,7 @@ class BatchEngine:
     def __init__(self, engine: Engine, *, n_slots: int = 8,
                  n_blocks: int | None = None, block_size: int = 16,
                  prefill_chunk: int = 32, max_seq_len: int | None = None,
+                 kv_dtype=None,
                  seed: int = 0, admission_pressure: float = 0.0,
                  retry: _guards.RetryPolicy | None = None,
                  nan_guard: bool = False, paged_attn: str = "fused",
@@ -217,7 +228,8 @@ class BatchEngine:
             n_blocks = n_slots * -(-max_seq_len // block_size)
         self.pool = KVPool(engine.config, n_blocks=n_blocks,
                            block_size=block_size, max_seq_len=max_seq_len,
-                           mesh=engine.mesh, axis=engine.model.axis)
+                           mesh=engine.mesh, axis=engine.model.axis,
+                           kv_dtype=kv_dtype)
         self.scheduler = Scheduler()
         self.metrics = Metrics(windowed=windowed_metrics)
         if blackbox:
@@ -274,9 +286,12 @@ class BatchEngine:
         # _incident_tick).
         self._inc_last_tick: float | None = None
         self._inc_idle_mark = 0.0
-        # KV dtype width feeding step_hbm_bytes (tiny test configs run
-        # f32; real configs bf16).
+        # Dtype widths feeding step_hbm_bytes: activations/weights run in
+        # the model dtype (tiny test configs f32; real configs bf16); the
+        # KV pool may be narrower (kv_dtype="int8"/"fp8"), in which case
+        # the per-row scale arenas are billed too (kv_scales=True).
         self._eff_itemsize = int(jnp.dtype(engine.config.dtype).itemsize)
+        self._eff_kv_itemsize = int(self.pool.kv_dtype.itemsize)
         # Optional zero-arg callable returning a kprobe ``stall_summary``
         # dict; when probes are wired it refines the ledger's stall bucket
         # into dma_wait / sem_spin detail (never reclassifies).
@@ -326,14 +341,16 @@ class BatchEngine:
         eng = self.engine
         V = eng.config.vocab_size
         spec = self.spec is not None
+        quant = self.pool.kv_quant
         sm_dec = eng._make_sm(eng.decode_mode, paged="decode",
-                              paged_attn=self.paged_attn)
+                              paged_attn=self.paged_attn, kv_quant=quant)
         # With speculation the ONE mixed step also emits the all-position
         # argmax continuation (``greedy``) — baked into the single trace,
         # so verify steps, chunked prefill, and plain mixed iterations all
         # share it and trace_counts stays {1,1}.
         sm_pre = eng._make_sm(eng.prefill_mode, paged="prefill",
-                              paged_attn=self.paged_attn, spec_verify=spec)
+                              paged_attn=self.paged_attn, spec_verify=spec,
+                              kv_quant=quant)
         temperature, top_p = eng.temperature, eng.top_p
         trace_counts = self.trace_counts
 
@@ -343,6 +360,50 @@ class BatchEngine:
         # WITHOUT a second compiled variant. ``finite`` is the matching
         # always-compiled guard (models/sampling.finite_logits_mask): every
         # rank computes it every step, only the host decides what to do.
+        #
+        # Quantized pools grow each step by two donated scale-arena
+        # operands/outputs right after the K/V pools — same fixed shapes,
+        # so it is still exactly ONE trace per step kind.
+
+        if quant:
+            @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+            def decode_step(params, tok, k, v, ks, vs, offsets,
+                            block_tables, slot_mask, corrupt, key):
+                trace_counts["decode"] += 1
+                ids = jnp.clip(tok, 0, V - 1)[:, None]
+                logits, k, v, ks, vs = sm_dec(params, ids, k, v, ks, vs,
+                                              offsets, block_tables,
+                                              slot_mask)
+                logits = logits + corrupt[:, None]
+                finite = finite_logits_mask(logits)
+                nxt = sample_token(logits, key, temperature=temperature,
+                                   top_p=top_p)
+                return nxt, finite, k, v, ks, vs
+
+            @functools.partial(jax.jit, donate_argnums=(2, 3, 4, 5))
+            def mixed_step(params, ids, k, v, ks, vs, offsets, block_tables,
+                           slot_mask, seq_lens, corrupt, key):
+                trace_counts["prefill"] += 1
+                ids = jnp.clip(ids, 0, V - 1)
+                if spec:
+                    logits, greedy, k, v, ks, vs = sm_pre(
+                        params, ids, k, v, ks, vs, offsets, block_tables,
+                        slot_mask, seq_lens)
+                else:
+                    logits, k, v, ks, vs = sm_pre(
+                        params, ids, k, v, ks, vs, offsets, block_tables,
+                        slot_mask, seq_lens)
+                logits = logits + corrupt[:, None]
+                finite = finite_logits_mask(logits)
+                nxt = sample_token(logits, key, temperature=temperature,
+                                   top_p=top_p)
+                if spec:
+                    return nxt, finite, greedy, k, v, ks, vs
+                return nxt, finite, k, v, ks, vs
+
+            self._decode_step = decode_step
+            self._mixed_step = mixed_step
+            return
 
         @functools.partial(jax.jit, donate_argnums=(2, 3))
         def decode_step(params, tok, k, v, offsets, block_tables, slot_mask,
@@ -405,11 +466,12 @@ class BatchEngine:
         same = (self.n_slots == other.n_slots
                 and self.prefill_chunk == other.prefill_chunk
                 and self.paged_attn == other.paged_attn
+                and self.pool.kv_dtype == other.pool.kv_dtype
                 and (self.spec is None) == (other.spec is None))
         if not same:
             raise ValueError("share_steps_from requires identical step "
                              "geometry (n_slots/prefill_chunk/paged_attn/"
-                             "speculation)")
+                             "kv_dtype/speculation)")
         self._decode_step = other._decode_step
         self._mixed_step = other._mixed_step
         self.trace_counts = other.trace_counts
@@ -1471,7 +1533,9 @@ class BatchEngine:
             flops=_pm.step_flops(cfg, rows),
             hbm_bytes=_pm.step_hbm_bytes(
                 cfg, rows, block_size=self.pool.block_size,
-                itemsize=self._eff_itemsize, method=self.paged_attn),
+                itemsize=self._eff_itemsize, method=self.paged_attn,
+                kv_itemsize=self._eff_kv_itemsize,
+                kv_scales=self.pool.kv_quant),
             comm_s=comm_s, tokens=tokens, tenants=tenants,
             stall_summary=stall)
 
@@ -1484,13 +1548,22 @@ class BatchEngine:
         key = self._next_key()   # drawn ONCE — retries replay the same key
         with _trace.span("decode_step",
                          active=int(sum(s is not None for s in self._slots))):
-            nxt, finite, k, v = self._call_step(
-                "engine.decode",
-                lambda corrupt: self._decode_step(
-                    self.engine.params, jnp.asarray(tok), st.k, st.v,
-                    offsets, tables, mask, corrupt, key))
+            if self.pool.kv_quant:
+                nxt, finite, k, v, ks, vs = self._call_step(
+                    "engine.decode",
+                    lambda corrupt: self._decode_step(
+                        self.engine.params, jnp.asarray(tok), st.k, st.v,
+                        st.k_scale, st.v_scale, offsets, tables, mask,
+                        corrupt, key))
+            else:
+                ks = vs = None
+                nxt, finite, k, v = self._call_step(
+                    "engine.decode",
+                    lambda corrupt: self._decode_step(
+                        self.engine.params, jnp.asarray(tok), st.k, st.v,
+                        offsets, tables, mask, corrupt, key))
             nxt = np.asarray(nxt)
-        self.pool.state = PagedKVState(k=k, v=v)
+        self.pool.state = PagedKVState(k=k, v=v, k_scale=ks, v_scale=vs)
         if self.efficiency is not None:
             rows, tenants = [], {}
             for s in self._slots:
@@ -1555,23 +1628,37 @@ class BatchEngine:
                          prefill_rows=int((seq_lens > 1).sum()),
                          spec_rows=len(proposals),
                          active=int(sum(s is not None for s in self._slots))):
+            quant = self.pool.kv_quant
+            ks = vs = None
+            if quant:
+                args = (st.k, st.v, st.k_scale, st.v_scale)
+            else:
+                args = (st.k, st.v)
             if self.spec is not None:
-                nxt, finite, greedy, k, v = self._call_step(
+                out = self._call_step(
                     "engine.prefill",
                     lambda corrupt: self._mixed_step(
-                        self.engine.params, jnp.asarray(ids), st.k, st.v,
+                        self.engine.params, jnp.asarray(ids), *args,
                         offsets, tables, mask, jnp.asarray(seq_lens),
                         corrupt, key))
+                if quant:
+                    nxt, finite, greedy, k, v, ks, vs = out
+                else:
+                    nxt, finite, greedy, k, v = out
                 greedy = np.asarray(greedy)
             else:
-                nxt, finite, k, v = self._call_step(
+                out = self._call_step(
                     "engine.prefill",
                     lambda corrupt: self._mixed_step(
-                        self.engine.params, jnp.asarray(ids), st.k, st.v,
+                        self.engine.params, jnp.asarray(ids), *args,
                         offsets, tables, mask, jnp.asarray(seq_lens),
                         corrupt, key))
+                if quant:
+                    nxt, finite, k, v, ks, vs = out
+                else:
+                    nxt, finite, k, v = out
             nxt = np.asarray(nxt)
-        self.pool.state = PagedKVState(k=k, v=v)
+        self.pool.state = PagedKVState(k=k, v=v, k_scale=ks, v_scale=vs)
         if self.efficiency is not None:
             rows, tenants = [], {}
             for i, s in enumerate(self._slots):

@@ -53,6 +53,44 @@ from triton_distributed_tpu.models.kv_cache import KVCache
 from triton_distributed_tpu.resilience import faults as _faults
 
 
+#: Wire dtypes the pool can quantize KV storage into. ``"fp8"`` is the
+#: serving-facing alias for ``float8_e4m3fn`` (the forward-pass fp8
+#: format; e5m2's extra exponent bit buys range KV values never use).
+KV_WIRE_DTYPES = {
+    "int8": jnp.int8,
+    "fp8": jnp.float8_e4m3fn,
+    "float8_e4m3fn": jnp.float8_e4m3fn,
+}
+
+#: Version tag of the per-row symmetric absmax scheme (layers/nn.py
+#: ``quantize_kv_rows``). Bump on ANY change to the quantization math —
+#: the fingerprint is what stops a cached block quantized under an old
+#: scheme from being adopted into a new-scheme pool.
+KV_QUANT_SCHEME = "rowmax:v1"
+
+
+def resolve_kv_dtype(config, kv_dtype):
+    """Map a ``kv_dtype`` knob value to a concrete wire dtype.
+
+    ``None`` (and the config dtype itself, by name or dtype object) means
+    unquantized storage in ``config.dtype``; ``"int8"``/``"fp8"`` select a
+    quantized wire format. Returns ``(jnp.dtype, quantized: bool)``.
+    """
+    if kv_dtype is None:
+        return jnp.dtype(config.dtype), False
+    if isinstance(kv_dtype, str) and kv_dtype in KV_WIRE_DTYPES:
+        return jnp.dtype(KV_WIRE_DTYPES[kv_dtype]), True
+    dt = jnp.dtype(kv_dtype)
+    if dt == jnp.dtype(config.dtype):
+        return dt, False
+    if dt in (jnp.dtype(jnp.int8), jnp.dtype(jnp.float8_e4m3fn)):
+        return dt, True
+    raise ValueError(
+        f"unsupported kv_dtype {kv_dtype!r}: expected None, "
+        f"{sorted(KV_WIRE_DTYPES)}, or the model dtype "
+        f"{jnp.dtype(config.dtype).name!r}")
+
+
 def blocks_needed(n_tokens: int, block_size: int) -> int:
     """THE block-rounding rule: ``ceil(n_tokens / block_size)``. One
     definition shared by allocation (``KVPool.blocks_for``) and admission
@@ -64,10 +102,18 @@ def blocks_needed(n_tokens: int, block_size: int) -> int:
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedKVState:
-    """Device half of the pool: the block arrays (functional pytree)."""
+    """Device half of the pool: the block arrays (functional pytree).
+
+    Quantized pools (``kv_dtype="int8"|"fp8"``) carry two extra arrays:
+    per-row f32 dequantization scales, shaped like the K/V arenas minus
+    head_dim. ``None`` (the unquantized default) is an empty pytree
+    subtree, so existing two-array construction sites keep working.
+    """
 
     k: jax.Array   # (n_layers, n_blocks, block_size, n_kv_heads, head_dim)
     v: jax.Array
+    k_scale: jax.Array | None = None   # (n_layers, n_blocks, bs, n_kv_heads)
+    v_scale: jax.Array | None = None
 
     @property
     def n_blocks(self) -> int:
@@ -87,23 +133,33 @@ class KVPool:
     """
 
     def __init__(self, config, *, n_blocks: int, block_size: int = 16,
-                 max_seq_len: int | None = None, mesh=None, axis: str = "tp"):
+                 max_seq_len: int | None = None, mesh=None, axis: str = "tp",
+                 kv_dtype=None):
         if n_blocks <= 0 or block_size <= 0:
             raise ValueError(f"bad pool geometry ({n_blocks=}, {block_size=})")
         self.block_size = block_size
         self.n_blocks = n_blocks
         self.max_seq_len = max_seq_len or config.max_length
         self.max_blocks_per_seq = math.ceil(self.max_seq_len / block_size)
+        self.kv_dtype, self.kv_quant = resolve_kv_dtype(config, kv_dtype)
         shape = (config.n_layers, n_blocks, block_size,
                  config.n_kv_heads, config.head_dim)
-        k = jnp.zeros(shape, config.dtype)
-        v = jnp.zeros(shape, config.dtype)
+        k = jnp.zeros(shape, self.kv_dtype)
+        v = jnp.zeros(shape, self.kv_dtype)
+        ks = vs = None
+        if self.kv_quant:
+            ks = jnp.zeros(shape[:-1], jnp.float32)
+            vs = jnp.zeros(shape[:-1], jnp.float32)
         if mesh is not None:
             from triton_distributed_tpu.runtime.mesh import sharding_for
 
             sh = sharding_for(KVCache.spec(axis)[0], mesh)
             k, v = jax.device_put(k, sh), jax.device_put(v, sh)
-        self.state = PagedKVState(k=k, v=v)
+            if self.kv_quant:
+                ssh = sharding_for(KVCache.scale_spec(axis), mesh)
+                ks = jax.device_put(ks, ssh)
+                vs = jax.device_put(vs, ssh)
+        self.state = PagedKVState(k=k, v=v, k_scale=ks, v_scale=vs)
         # LIFO free list, low block ids first out — recently freed blocks
         # are reused immediately (warm in whatever cache level they touched).
         self._free: list[int] = list(range(n_blocks - 1, -1, -1))
@@ -112,6 +168,12 @@ class KVPool:
         # tables currently containing the block). Keys are the cache-owned
         # blocks; refcount 0 = unreferenced-but-resident (LRU-evictable).
         self._cached: dict[int, int] = {}
+        # Cached-block provenance: block id -> kv_fingerprint() at promote
+        # time. Within one pool's lifetime every entry matches the pool's
+        # own fingerprint (the pool never changes mode), but checkpoint
+        # restore / cross-pool bookkeeping bugs would not — ``ensure``
+        # refuses to adopt a block whose recorded fingerprint disagrees.
+        self._cached_fp: dict[int, str] = {}
         self._cache = None        # attached RadixPrefixCache (LRU reclaim)
         self._cow_jit = None      # compiled-once block copy (lazy)
 
@@ -171,7 +233,17 @@ class KVPool:
         bit-identical-resume contract."""
         return {"n_blocks": self.n_blocks, "block_size": self.block_size,
                 "max_seq_len": self.max_seq_len,
-                "max_blocks_per_seq": self.max_blocks_per_seq}
+                "max_blocks_per_seq": self.max_blocks_per_seq,
+                "kv_dtype": self.kv_dtype.name}
+
+    def kv_fingerprint(self) -> str:
+        """Wire-format identity of this pool's KV bytes: ``dtype:scheme``
+        (e.g. ``"int8:rowmax:v1"``, ``"bfloat16:none"``). Adoption of a
+        cached block is only legal between identical fingerprints — the
+        block's stored bytes are meaningless under any other
+        (dtype, quantization scheme) pair."""
+        scheme = KV_QUANT_SCHEME if self.kv_quant else "none"
+        return f"{self.kv_dtype.name}:{scheme}"
 
     def owned(self, seq_id) -> int:
         """Blocks currently owned by ``seq_id`` (0 if unknown)."""
@@ -212,10 +284,17 @@ class KVPool:
             raise ValueError(
                 f"cache adoption for {seq_id!r} is admission-time only: "
                 f"the sequence already owns a table")
+        here = self.kv_fingerprint()
         for b in adopt + ([cow_src] if cow_src is not None else []):
             if b not in self._cached:
                 raise KeyError(f"adopting block {b} that is not "
                                f"cache-resident")
+            fp = self._cached_fp.get(b, here)
+            if fp != here:
+                raise ValueError(
+                    f"adopting block {b} quantized as {fp!r} into a "
+                    f"{here!r} pool: mixed-dtype adoption would hand the "
+                    f"sequence bytes from an incompatible wire format")
         n_cow = 1 if cow_src is not None else 0
         have = (len(table) if table is not None
                 else len(adopt) + n_cow)
@@ -351,6 +430,7 @@ class KVPool:
         if block in self._cached:
             raise ValueError(f"block {block} is already cache-resident")
         self._cached[block] = 1
+        self._cached_fp[block] = self.kv_fingerprint()
 
     def uncache(self, block: int) -> None:
         """Cache eviction endpoint: drop residency and free the block.
@@ -363,23 +443,33 @@ class KVPool:
             raise ValueError(f"uncache of block {block} with {r} live "
                              f"references")
         del self._cached[block]
+        self._cached_fp.pop(block, None)
         self._free.append(block)
 
     def _copy_block_device(self, src: int, dst: int) -> None:
         """Copy-on-write kernel: duplicate block ``src``'s K/V rows (every
-        layer) into ``dst`` on device. Compiled ONCE per pool — src/dst are
-        traced scalars, so CoW churn never retraces — with both pool arrays
-        donated (the copy is in-place for HBM accounting, like the steps)."""
+        layer) into ``dst`` on device — and, in a quantized pool, the
+        block's scale rows with them (a wire-dtype row without its scale
+        is garbage; scales MOVE with their blocks). Compiled ONCE per pool
+        — src/dst are traced scalars, so CoW churn never retraces — with
+        all pool arrays donated (the copy is in-place for HBM accounting,
+        like the steps)."""
         if self._cow_jit is None:
-            @functools.partial(jax.jit, donate_argnums=(0, 1))
-            def cow(k, v, s, d):
-                return (k.at[:, d].set(k[:, s]), v.at[:, d].set(v[:, s]))
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def cow(k, v, ks, vs, s, d):
+                k = k.at[:, d].set(k[:, s])
+                v = v.at[:, d].set(v[:, s])
+                if ks is not None:
+                    ks = ks.at[:, d].set(ks[:, s])
+                    vs = vs.at[:, d].set(vs[:, s])
+                return k, v, ks, vs
 
             self._cow_jit = cow
         st = self.state
-        k, v = self._cow_jit(st.k, st.v, jnp.asarray(src, jnp.int32),
-                             jnp.asarray(dst, jnp.int32))
-        self.state = PagedKVState(k=k, v=v)
+        k, v, ks, vs = self._cow_jit(
+            st.k, st.v, st.k_scale, st.v_scale,
+            jnp.asarray(src, jnp.int32), jnp.asarray(dst, jnp.int32))
+        self.state = PagedKVState(k=k, v=v, k_scale=ks, v_scale=vs)
 
     def fragmentation(self) -> dict:
         """Free-list fragmentation stats for the perf flight recorder:
@@ -454,3 +544,23 @@ class KVPool:
                    for b in owned + self._free + list(self._cached))
         empty = [sid for sid, t in self._tables.items() if not t]
         assert not empty, f"empty (stale) tables for seq_ids {empty!r}"
+        # Quantized-mode soundness: every cache-resident block carries a
+        # recorded wire fingerprint (and ONLY residents do), and the scale
+        # arenas exist iff the pool is quantized, shaped like the K/V
+        # arenas minus head_dim — scales partition with their blocks.
+        assert set(self._cached_fp) == set(self._cached), (
+            "cached-block fingerprints out of sync with residency")
+        st = self.state
+        if self.kv_quant:
+            assert st.k_scale is not None and st.v_scale is not None, (
+                "quantized pool missing scale arenas")
+            assert (st.k_scale.shape == st.v_scale.shape
+                    == st.k.shape[:-1]), (
+                f"scale arena shape {st.k_scale.shape} != KV arena rows "
+                f"{st.k.shape[:-1]}")
+            assert st.k_scale.dtype == jnp.float32
+        else:
+            assert st.k_scale is None and st.v_scale is None, (
+                "unquantized pool carrying scale arenas")
+        assert st.k.dtype == self.kv_dtype, (
+            f"pool arena dtype {st.k.dtype} != declared {self.kv_dtype}")
